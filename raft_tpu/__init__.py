@@ -1,0 +1,21 @@
+"""raft_tpu — a TPU-native optical-flow framework (JAX/XLA/Pallas/pjit).
+
+A from-scratch reimplementation of the capabilities of TensorFlowing/RAFT
+(RAFT: Recurrent All-Pairs Field Transforms for Optical Flow, ECCV 2020),
+designed TPU-first:
+
+- NHWC layout everywhere (TPU-native conv layout), bf16 compute policy.
+- The iterative refinement loop is a ``jax.lax.scan`` under ``jit``.
+- The all-pairs correlation volume is an MXU einsum; the memory-efficient
+  on-demand path is a blockwise formulation (and a Pallas kernel) instead of
+  the reference's CUDA scatter kernel.
+- Data parallelism is SPMD over a ``jax.sharding.Mesh`` with psum gradient
+  all-reduce over ICI, replacing ``nn.DataParallel``.
+"""
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models.raft import RAFT
+
+__version__ = "0.1.0"
+
+__all__ = ["RAFT", "RAFTConfig", "__version__"]

@@ -1,0 +1,98 @@
+"""Frozen model/training configuration.
+
+The reference threads a mutable argparse ``args`` namespace through the model
+(which mutates it in-place: reference ``core/raft.py:29-45`` sets
+``corr_levels``/``corr_radius``/``dropout``/``alternate_corr`` and
+``core/update.py:65,82`` reads them back).  Here configuration is a frozen
+dataclass resolved once at the CLI edge and hashable, so it can be a static
+argument under ``jax.jit``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class RAFTConfig:
+    """Model hyperparameters.
+
+    Mirrors the reference's two presets (``core/raft.py:29-39``): the full
+    model (hidden 128 / context 128 / radius 4) and the small model
+    (hidden 96 / context 64 / radius 3).
+    """
+
+    small: bool = False
+    hidden_dim: int = 128
+    context_dim: int = 128
+    corr_levels: int = 4
+    corr_radius: int = 4
+    dropout: float = 0.0
+    # 'allpairs' materializes the pyramid (reference CorrBlock, corr.py:12-60);
+    # 'chunked' is the memory-efficient blockwise path (reference
+    # AlternateCorrBlock + alt_cuda_corr, corr.py:63-91); 'pallas' is the
+    # fused TPU kernel version of 'chunked'.
+    corr_impl: str = "allpairs"
+    # Pixels per block for the chunked/pallas on-demand correlation path.
+    corr_block_size: int = 256
+    # bf16 compute for encoders + update block (replaces the reference's
+    # torch.cuda.amp autocast, raft.py:11-21,99,110,127); correlation is
+    # always fp32 (reference corr.py:50 casts .float()).
+    compute_dtype: str = "float32"
+    # Rematerialize the scan body in backward (memory/flops trade; the
+    # reference has no equivalent — torch retains all activations).
+    remat: bool = True
+
+    @classmethod
+    def full(cls, **kw) -> "RAFTConfig":
+        return cls(small=False, hidden_dim=128, context_dim=128,
+                   corr_levels=4, corr_radius=4, **kw)
+
+    @classmethod
+    def small_model(cls, **kw) -> "RAFTConfig":
+        return cls(small=True, hidden_dim=96, context_dim=64,
+                   corr_levels=4, corr_radius=3, **kw)
+
+    @property
+    def corr_planes(self) -> int:
+        # levels * (2r+1)^2, reference update.py:65,82
+        return self.corr_levels * (2 * self.corr_radius + 1) ** 2
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+    def replace(self, **kw) -> "RAFTConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training hyperparameters (reference ``train.py:218-239`` flags)."""
+
+    name: str = "raft"
+    stage: str = "chairs"
+    restore_ckpt: Optional[str] = None
+    validation: Tuple[str, ...] = ()
+    lr: float = 4e-4
+    num_steps: int = 100000
+    batch_size: int = 6
+    image_size: Tuple[int, int] = (384, 512)
+    iters: int = 12
+    wdecay: float = 1e-4
+    epsilon: float = 1e-8
+    clip: float = 1.0
+    gamma: float = 0.8          # exponential weighting, train.py:47
+    max_flow: float = 400.0     # loss exclusion threshold, train.py:47
+    add_noise: bool = False
+    seed: int = 1234
+    # Validation / checkpoint cadence (train.py:185-198, VAL_FREQ=5000).
+    val_freq: int = 5000
+    log_freq: int = 100         # Logger SUM_FREQ, train.py:91
+    freeze_bn: bool = False     # all stages but chairs, train.py:147-148
+    ckpt_dir: str = "checkpoints"
+    # Number of data-parallel shards (devices); resolved at runtime.
+    num_devices: int = 0

@@ -1,0 +1,20 @@
+from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
+from raft_tpu.models.update import (
+    BasicUpdateBlock,
+    ConvGRU,
+    FlowHead,
+    SepConvGRU,
+    SmallUpdateBlock,
+)
+from raft_tpu.models.raft import RAFT
+
+__all__ = [
+    "BasicEncoder",
+    "SmallEncoder",
+    "BasicUpdateBlock",
+    "SmallUpdateBlock",
+    "ConvGRU",
+    "SepConvGRU",
+    "FlowHead",
+    "RAFT",
+]

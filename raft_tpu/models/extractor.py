@@ -1,0 +1,83 @@
+"""Feature / context encoders (NHWC, 1/8 resolution).
+
+Re-designs the reference's ``core/extractor.py:118-267``:
+
+- ``BasicEncoder``: 7x7/s2 stem -> three residual stages (64, 96/s2, 128/s2)
+  -> 1x1 projection (extractor.py:135-148).
+- ``SmallEncoder``: bottleneck blocks, 32 -> 64/s2 -> 96/s2
+  (extractor.py:212-227).
+
+Both take frames stacked on the batch axis for the shared-weight two-frame
+encode (the reference's list-input trick, extractor.py:168-174, becomes an
+explicit ``jnp.concatenate`` at the caller).  Dropout is channel-wise
+(torch Dropout2d, extractor.py:186-187) -> flax Dropout broadcast over the
+spatial axes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from raft_tpu.models.layers import BottleneckBlock, Norm, ResidualBlock, conv
+
+
+class BasicEncoder(nn.Module):
+    output_dim: int = 128
+    norm: str = "batch"
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, freeze_bn: bool = False):
+        dt = self.dtype
+        x = x.astype(dt)
+        x = conv(64, 7, 2, dt, name="conv1", in_features=3)(x)
+        # stem GroupNorm uses 8 groups, not 64//8 (reference extractor.py:124)
+        x = Norm(self.norm, 64, num_groups=8, dtype=dt, name="norm1")(
+            x, train, freeze_bn)
+        x = nn.relu(x)
+
+        for i, (planes, stride) in enumerate(
+                [(64, 1), (64, 1), (96, 2), (96, 1), (128, 2), (128, 1)]):
+            x = ResidualBlock(planes, self.norm, stride, dt,
+                              name=f"layer{i // 2 + 1}_{i % 2}")(
+                x, train, freeze_bn)
+
+        x = conv(self.output_dim, 1, 1, dt, name="conv2", in_features=128)(x)
+
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, broadcast_dims=(1, 2),
+                           deterministic=not train)(x)
+        return x
+
+
+class SmallEncoder(nn.Module):
+    output_dim: int = 128
+    norm: str = "batch"
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, freeze_bn: bool = False):
+        dt = self.dtype
+        x = x.astype(dt)
+        x = conv(32, 7, 2, dt, name="conv1", in_features=3)(x)
+        x = Norm(self.norm, 32, num_groups=8, dtype=dt, name="norm1")(
+            x, train, freeze_bn)
+        x = nn.relu(x)
+
+        for i, (planes, stride) in enumerate(
+                [(32, 1), (32, 1), (64, 2), (64, 1), (96, 2), (96, 1)]):
+            x = BottleneckBlock(planes, self.norm, stride, dt,
+                                name=f"layer{i // 2 + 1}_{i % 2}")(
+                x, train, freeze_bn)
+
+        x = conv(self.output_dim, 1, 1, dt, name="conv2", in_features=96)(x)
+
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, broadcast_dims=(1, 2),
+                           deterministic=not train)(x)
+        return x

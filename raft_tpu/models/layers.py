@@ -1,0 +1,178 @@
+"""Shared building blocks: conv + norm factories, residual blocks (NHWC).
+
+TPU-first re-design of the reference's ``core/extractor.py:6-115`` blocks:
+NHWC layout (native for TPU convolutions), flax.linen functional modules,
+explicit train/freeze flags instead of module-level ``.eval()`` mutation.
+
+Norm semantics parity:
+- 'group'    -> GroupNorm(planes // 8 groups)    (extractor.py:16-20)
+- 'batch'    -> BatchNorm (running stats; ``freeze_bn`` pins them, the
+                functional equivalent of RAFT.freeze_bn, raft.py:58-61)
+- 'instance' -> per-sample, per-channel spatial norm, no affine
+                (torch InstanceNorm2d defaults: affine=False)
+- 'none'     -> identity
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# torch kaiming_normal_(mode='fan_out', nonlinearity='relu') equivalent
+# (reference extractor.py:150-153).
+kaiming_out = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+def _torch_default_uniform(key, shape, dtype=jnp.float32):
+    """torch Conv2d default init: kaiming_uniform_(a=sqrt(5)) for weights and
+    U(-1/sqrt(fan_in), 1/sqrt(fan_in)) for biases — both reduce to the same
+    bound.  Weight shape is HWIO (fan_in = prod(shape[:-1])); bias shape is
+    (features,) and the bound must then come from the matching conv's fan_in,
+    so biases use :func:`torch_bias_init`."""
+    import numpy as np
+
+    fan_in = int(np.prod(shape[:-1])) if len(shape) > 1 else shape[0]
+    bound = 1.0 / (fan_in ** 0.5)
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+def torch_bias_init(fan_in: int):
+    bound = 1.0 / (fan_in ** 0.5)
+
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+def conv(features: int, kernel, stride=1, dtype=jnp.float32,
+         name: Optional[str] = None, torch_default_init: bool = False,
+         in_features: Optional[int] = None) -> nn.Conv:
+    """3x3/7x7/... conv with torch-style symmetric padding.
+
+    XLA's ``SAME`` pads stride-2 convs asymmetrically (left-light), while
+    torch pads symmetrically by ``k // 2`` — using SAME would shift every
+    stride-2 feature map by one input pixel and break weight-conversion
+    parity, so padding is always explicit here.
+
+    ``torch_default_init=True`` reproduces torch's default Conv2d init
+    (used by the reference everywhere *except* the encoders, which apply
+    kaiming fan_out on weights only, extractor.py:150-153).  Bias init
+    needs the conv's fan_in, which flax initializers can't see from the
+    bias shape alone: pass ``in_features`` to enable torch-parity bias
+    init (otherwise biases are zeros).
+    """
+    if isinstance(kernel, int):
+        kernel = (kernel, kernel)
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    padding = [((k - 1) // 2, (k - 1) // 2) for k in kernel]
+    kernel_init = _torch_default_uniform if torch_default_init else kaiming_out
+    if in_features is not None:
+        bias_init = torch_bias_init(in_features * kernel[0] * kernel[1])
+    else:
+        bias_init = nn.initializers.zeros_init()
+    return nn.Conv(features, kernel, strides=stride, padding=padding,
+                   kernel_init=kernel_init, bias_init=bias_init,
+                   dtype=dtype, name=name)
+
+
+class Norm(nn.Module):
+    """Dispatch over the reference's four norm modes."""
+
+    kind: str
+    channels: int
+    num_groups: Optional[int] = None  # default: channels // 8
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, freeze_bn: bool = False):
+        if self.kind == "group":
+            groups = self.num_groups or self.channels // 8
+            return nn.GroupNorm(num_groups=groups, epsilon=1e-5,
+                                dtype=self.dtype)(x)
+        if self.kind == "batch":
+            return nn.BatchNorm(
+                use_running_average=(not train) or freeze_bn,
+                momentum=0.9, epsilon=1e-5, dtype=self.dtype)(x)
+        if self.kind == "instance":
+            return nn.GroupNorm(num_groups=None, group_size=1,
+                                use_bias=False, use_scale=False,
+                                epsilon=1e-5, dtype=self.dtype)(x)
+        if self.kind == "none":
+            return x
+        raise ValueError(f"unknown norm kind: {self.kind}")
+
+
+class ResidualBlock(nn.Module):
+    """Two 3x3 convs + skip (reference extractor.py:6-57)."""
+
+    planes: int
+    norm: str
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, freeze_bn: bool = False):
+        cin = x.shape[-1]
+        y = conv(self.planes, 3, self.stride, self.dtype, name="conv1",
+                 in_features=cin)(x)
+        y = Norm(self.norm, self.planes, dtype=self.dtype, name="norm1")(
+            y, train, freeze_bn)
+        y = nn.relu(y)
+        y = conv(self.planes, 3, 1, self.dtype, name="conv2",
+                 in_features=self.planes)(y)
+        y = Norm(self.norm, self.planes, dtype=self.dtype, name="norm2")(
+            y, train, freeze_bn)
+        y = nn.relu(y)
+
+        if self.stride != 1:
+            x = conv(self.planes, 1, self.stride, self.dtype,
+                     name="downsample_conv", in_features=cin)(x)
+            x = Norm(self.norm, self.planes, dtype=self.dtype,
+                     name="norm3")(x, train, freeze_bn)
+        return nn.relu(x + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck (reference extractor.py:60-115).
+
+    Note the reference's quirk: GroupNorm group count is ``planes // 8``
+    even for the ``planes // 4``-channel inner convs (extractor.py:72-74);
+    reproduced for weight parity.
+    """
+
+    planes: int
+    norm: str
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, freeze_bn: bool = False):
+        cin = x.shape[-1]
+        p4 = self.planes // 4
+        groups = self.planes // 8
+        y = conv(p4, 1, 1, self.dtype, name="conv1", in_features=cin)(x)
+        y = Norm(self.norm, p4, num_groups=groups, dtype=self.dtype,
+                 name="norm1")(y, train, freeze_bn)
+        y = nn.relu(y)
+        y = conv(p4, 3, self.stride, self.dtype, name="conv2",
+                 in_features=p4)(y)
+        y = Norm(self.norm, p4, num_groups=groups, dtype=self.dtype,
+                 name="norm2")(y, train, freeze_bn)
+        y = nn.relu(y)
+        y = conv(self.planes, 1, 1, self.dtype, name="conv3",
+                 in_features=p4)(y)
+        y = Norm(self.norm, self.planes, num_groups=groups, dtype=self.dtype,
+                 name="norm3")(y, train, freeze_bn)
+        y = nn.relu(y)
+
+        if self.stride != 1:
+            x = conv(self.planes, 1, self.stride, self.dtype,
+                     name="downsample_conv", in_features=cin)(x)
+            x = Norm(self.norm, self.planes, num_groups=groups,
+                     dtype=self.dtype, name="norm4")(x, train, freeze_bn)
+        return nn.relu(x + y)

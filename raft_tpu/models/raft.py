@@ -1,0 +1,164 @@
+"""The RAFT model: encoders + correlation + scanned refinement (NHWC).
+
+TPU-first re-design of the reference's ``core/raft.py``:
+
+- The per-iteration Python loop (raft.py:122-139) becomes a single
+  ``flax.linen.scan`` over a shared-weight refinement step — traced once,
+  compiled once, with optional rematerialization of the step body
+  (``config.remat``) for the backward pass.
+- The per-step ``coords1.detach()`` (raft.py:123) becomes
+  ``jax.lax.stop_gradient``.
+- Frames are encoded with shared weights by stacking them on the batch axis
+  (the reference's list-input trick, extractor.py:171-174).
+- Mixed precision: encoders and the update block run in
+  ``config.compute_dtype`` (bf16 on TPU — replaces the reference's
+  torch.cuda.amp autocast + GradScaler, no loss scaling needed for bf16);
+  correlation volumes and the coordinate state stay fp32
+  (raft.py:102-103, corr.py:50).
+
+API:
+  ``model.apply(variables, image1, image2, iters=12)`` ->
+      ``(iters, B, H, W, 2)`` stacked per-iteration upsampled flows (train
+      mode list at raft.py:144).
+  ``test_mode=True`` -> ``(flow_low, flow_up)`` (raft.py:141-142).
+
+Images are NHWC float in [0, 255]; flow is ``(..., 2)`` with ``(x, y)``
+channel order matching the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
+from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
+from raft_tpu.ops.corr import (
+    build_corr_pyramid,
+    chunked_corr_lookup,
+    corr_lookup,
+    pool_fmap_pyramid,
+)
+from raft_tpu.ops.sampler import coords_grid, upflow8
+from raft_tpu.ops.upsample import convex_upsample
+
+
+class RefinementStep(nn.Module):
+    """One GRU refinement iteration (the body of the reference's hot loop,
+    raft.py:122-139)."""
+
+    config: RAFTConfig
+
+    @nn.compact
+    def __call__(self, carry, inputs):
+        cfg = self.config
+        dt = cfg.dtype
+        net, coords1 = carry
+        inp, coords0, corr_state = inputs
+
+        coords1 = jax.lax.stop_gradient(coords1)
+
+        if cfg.corr_impl == "allpairs":
+            corr = corr_lookup(corr_state, coords1, cfg.corr_radius)
+        elif cfg.corr_impl == "chunked":
+            fmap1, f2_pyramid = corr_state
+            corr = chunked_corr_lookup(fmap1, f2_pyramid, coords1,
+                                       cfg.corr_radius,
+                                       block_size=cfg.corr_block_size)
+        elif cfg.corr_impl == "pallas":
+            raise NotImplementedError(
+                "corr_impl='pallas' is not wired up yet; use 'chunked'")
+        else:
+            raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")
+
+        flow = coords1 - coords0
+        if cfg.small:
+            block = SmallUpdateBlock(cfg.hidden_dim, dt, name="update_block")
+        else:
+            block = BasicUpdateBlock(cfg.hidden_dim, dt, name="update_block")
+        net, mask, delta_flow = block(
+            net, inp, corr.astype(dt), flow.astype(dt))
+
+        coords1 = coords1 + delta_flow.astype(jnp.float32)
+        new_flow = coords1 - coords0
+
+        if mask is None:
+            flow_up = upflow8(new_flow)
+        else:
+            flow_up = convex_upsample(new_flow, mask.astype(jnp.float32))
+
+        return (net, coords1), flow_up
+
+
+class RAFT(nn.Module):
+    """Full / small RAFT (reference core/raft.py:24-144)."""
+
+    config: RAFTConfig = RAFTConfig()
+
+    @nn.compact
+    def __call__(self, image1, image2, iters: int = 12,
+                 flow_init: Optional[jax.Array] = None,
+                 test_mode: bool = False, train: bool = False,
+                 freeze_bn: bool = False):
+        cfg = self.config
+        dt = cfg.dtype
+        hdim, cdim = cfg.hidden_dim, cfg.context_dim
+
+        image1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
+        image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
+
+        # Shared-weight two-frame encode: stack on batch.
+        if cfg.small:
+            fnet = SmallEncoder(128, "instance", cfg.dropout, dt, name="fnet")
+            cnet = SmallEncoder(hdim + cdim, "none", cfg.dropout, dt,
+                                name="cnet")
+        else:
+            fnet = BasicEncoder(256, "instance", cfg.dropout, dt, name="fnet")
+            cnet = BasicEncoder(hdim + cdim, "batch", cfg.dropout, dt,
+                                name="cnet")
+
+        both = jnp.concatenate([image1, image2], axis=0)
+        fmaps = fnet(both.astype(dt), train, freeze_bn)
+        B = image1.shape[0]
+        fmap1 = fmaps[:B].astype(jnp.float32)
+        fmap2 = fmaps[B:].astype(jnp.float32)
+
+        if cfg.corr_impl == "allpairs":
+            corr_state = build_corr_pyramid(fmap1, fmap2, cfg.corr_levels)
+        elif cfg.corr_impl in ("chunked", "pallas"):
+            corr_state = (fmap1, pool_fmap_pyramid(fmap2, cfg.corr_levels))
+        else:
+            raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")
+
+        ctx = cnet(image1.astype(dt), train, freeze_bn)
+        net = jnp.tanh(ctx[..., :hdim])
+        inp = nn.relu(ctx[..., hdim:])
+
+        _, H8, W8, _ = fmap1.shape
+        coords0 = coords_grid(B, H8, W8)
+        coords1 = coords_grid(B, H8, W8)
+        if flow_init is not None:
+            coords1 = coords1 + flow_init
+
+        step = RefinementStep
+        if cfg.remat:
+            step = nn.remat(RefinementStep)
+        scan = nn.scan(
+            step,
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": True},
+            in_axes=nn.broadcast,
+            out_axes=0,
+            length=iters,
+        )(cfg, name="refine")
+
+        (net, coords1), flow_ups = scan(
+            (net, coords1), (inp, coords0, corr_state))
+
+        if test_mode:
+            return coords1 - coords0, flow_ups[-1]
+        return flow_ups

@@ -1,0 +1,144 @@
+"""Recurrent update blocks (NHWC).
+
+Re-designs the reference's ``core/update.py``: motion encoder + ConvGRU +
+flow head (+ convex-upsample mask head in the full model).  All convs are
+NHWC; the GRU is the natural ``lax.scan`` body (driven from the RAFT model).
+
+Parity notes:
+- The mask head output is scaled by 0.25 ("to balence gradients",
+  update.py:123-125).
+- ``BasicMotionEncoder`` emits 126 channels and appends the raw 2-channel
+  flow -> 128 (update.py:91-97); the small variant emits 80 + 2 -> 82
+  (update.py:70-77).
+- ``SepConvGRU`` runs a horizontal (1x5) then vertical (5x1) GRU pass
+  (update.py:33-60).
+- Init: the reference applies kaiming only to the encoders; update-block
+  convs keep torch's *default* Conv2d init — reproduced via
+  ``torch_default_init``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from raft_tpu.models.layers import conv
+
+
+def _tconv(features, kernel, cin, dtype, name):
+    """Conv with torch's *default* init (the reference applies kaiming only
+    to the encoders; update-block convs keep torch defaults)."""
+    return conv(features, kernel, 1, dtype, name=name,
+                torch_default_init=True, in_features=cin)
+
+
+class FlowHead(nn.Module):
+    hidden_dim: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cin = x.shape[-1]
+        x = nn.relu(_tconv(self.hidden_dim, 3, cin, self.dtype, "conv1")(x))
+        return _tconv(2, 3, self.hidden_dim, self.dtype, "conv2")(x)
+
+
+class ConvGRU(nn.Module):
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, x):
+        hx = jnp.concatenate([h, x], axis=-1)
+        cin = hx.shape[-1]
+        z = nn.sigmoid(_tconv(self.hidden_dim, 3, cin, self.dtype, "convz")(hx))
+        r = nn.sigmoid(_tconv(self.hidden_dim, 3, cin, self.dtype, "convr")(hx))
+        q = jnp.tanh(_tconv(self.hidden_dim, 3, cin, self.dtype, "convq")(
+            jnp.concatenate([r * h, x], axis=-1)))
+        return (1 - z) * h + z * q
+
+
+class SepConvGRU(nn.Module):
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, x):
+        dt = self.dtype
+        # horizontal pass (1x5 kernels)
+        hx = jnp.concatenate([h, x], axis=-1)
+        cin = hx.shape[-1]
+        z = nn.sigmoid(_tconv(self.hidden_dim, (1, 5), cin, dt, "convz1")(hx))
+        r = nn.sigmoid(_tconv(self.hidden_dim, (1, 5), cin, dt, "convr1")(hx))
+        q = jnp.tanh(_tconv(self.hidden_dim, (1, 5), cin, dt, "convq1")(
+            jnp.concatenate([r * h, x], axis=-1)))
+        h = (1 - z) * h + z * q
+
+        # vertical pass (5x1 kernels)
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(_tconv(self.hidden_dim, (5, 1), cin, dt, "convz2")(hx))
+        r = nn.sigmoid(_tconv(self.hidden_dim, (5, 1), cin, dt, "convr2")(hx))
+        q = jnp.tanh(_tconv(self.hidden_dim, (5, 1), cin, dt, "convq2")(
+            jnp.concatenate([r * h, x], axis=-1)))
+        return (1 - z) * h + z * q
+
+
+class SmallMotionEncoder(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        dt = self.dtype
+        cor = nn.relu(_tconv(96, 1, corr.shape[-1], dt, "convc1")(corr))
+        flo = nn.relu(_tconv(64, 7, 2, dt, "convf1")(flow))
+        flo = nn.relu(_tconv(32, 3, 64, dt, "convf2")(flo))
+        out = nn.relu(_tconv(80, 3, 128, dt, "conv")(
+            jnp.concatenate([cor, flo], axis=-1)))
+        return jnp.concatenate([out, flow], axis=-1)
+
+
+class BasicMotionEncoder(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        dt = self.dtype
+        cor = nn.relu(_tconv(256, 1, corr.shape[-1], dt, "convc1")(corr))
+        cor = nn.relu(_tconv(192, 3, 256, dt, "convc2")(cor))
+        flo = nn.relu(_tconv(128, 7, 2, dt, "convf1")(flow))
+        flo = nn.relu(_tconv(64, 3, 128, dt, "convf2")(flo))
+        out = nn.relu(_tconv(126, 3, 64 + 192, dt, "conv")(
+            jnp.concatenate([cor, flo], axis=-1)))
+        return jnp.concatenate([out, flow], axis=-1)
+
+
+class SmallUpdateBlock(nn.Module):
+    hidden_dim: int = 96
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, net, inp, corr, flow):
+        motion = SmallMotionEncoder(self.dtype, name="encoder")(flow, corr)
+        x = jnp.concatenate([inp, motion], axis=-1)
+        net = ConvGRU(self.hidden_dim, self.dtype, name="gru")(net, x)
+        delta_flow = FlowHead(128, self.dtype, name="flow_head")(net)
+        return net, None, delta_flow
+
+
+class BasicUpdateBlock(nn.Module):
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, net, inp, corr, flow):
+        motion = BasicMotionEncoder(self.dtype, name="encoder")(flow, corr)
+        x = jnp.concatenate([inp, motion], axis=-1)
+        net = SepConvGRU(self.hidden_dim, self.dtype, name="gru")(net, x)
+        delta_flow = FlowHead(256, self.dtype, name="flow_head")(net)
+
+        mask = nn.relu(_tconv(256, 3, self.hidden_dim, self.dtype,
+                              "mask_conv1")(net))
+        mask = _tconv(64 * 9, 1, 256, self.dtype, "mask_conv2")(mask)
+        return net, 0.25 * mask, delta_flow

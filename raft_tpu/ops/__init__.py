@@ -1,0 +1,27 @@
+from raft_tpu.ops.sampler import (
+    bilinear_sampler,
+    coords_grid,
+    resize_bilinear_align_corners,
+    upflow8,
+)
+from raft_tpu.ops.pad import InputPadder
+from raft_tpu.ops.upsample import convex_upsample
+from raft_tpu.ops.corr import (
+    all_pairs_correlation,
+    build_corr_pyramid,
+    corr_lookup,
+    chunked_corr_lookup,
+)
+
+__all__ = [
+    "bilinear_sampler",
+    "coords_grid",
+    "resize_bilinear_align_corners",
+    "upflow8",
+    "InputPadder",
+    "convex_upsample",
+    "all_pairs_correlation",
+    "build_corr_pyramid",
+    "corr_lookup",
+    "chunked_corr_lookup",
+]

@@ -1,0 +1,36 @@
+"""Input padding to multiples of 8 (reference ``core/utils/utils.py:7-24``).
+
+The model downsamples by 8, so H and W must be divisible by 8.  'sintel'
+mode centers the height padding; every other mode puts all height padding at
+the bottom.  Width padding is always centered.  Padding is edge-replicate.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class InputPadder:
+    """Pads NHWC images so H, W are divisible by 8; unpads flow back."""
+
+    def __init__(self, dims, mode: str = "sintel"):
+        self.ht, self.wd = dims[-3:-1] if len(dims) >= 3 else dims
+        pad_ht = (((self.ht // 8) + 1) * 8 - self.ht) % 8
+        pad_wd = (((self.wd // 8) + 1) * 8 - self.wd) % 8
+        if mode == "sintel":
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2,
+                         pad_ht // 2, pad_ht - pad_ht // 2]
+        else:
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht]
+
+    def pad(self, *inputs):
+        l, r, t, b = self._pad
+        out = [jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)), mode="edge")
+               for x in inputs]
+        return out if len(out) > 1 else out[0]
+
+    def unpad(self, x):
+        ht, wd = x.shape[-3:-1]
+        l, r, t, b = self._pad
+        return x[..., t:ht - b, l:wd - r, :]

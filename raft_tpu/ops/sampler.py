@@ -1,0 +1,127 @@
+"""Pure-JAX tensor utilities (NHWC layout).
+
+TPU-native equivalents of the reference's ``core/utils/utils.py``:
+
+- ``coords_grid`` (utils.py:74-77) — here NHWC ``(B, H, W, 2)`` with the last
+  axis ordered ``(x, y)`` like the reference's channel order.
+- ``bilinear_sampler`` (utils.py:57-71) — the reference wraps
+  ``F.grid_sample(align_corners=True)`` with zeros padding; with
+  ``align_corners=True`` the normalize/denormalize round-trips exactly to
+  pixel coordinates, so this implements direct pixel-space bilinear
+  interpolation with out-of-bounds corner contributions zeroed.
+- ``upflow8`` (utils.py:80-82) — ``align_corners=True`` bilinear resize
+  expressed as two dense interpolation matmuls (MXU-friendly on TPU, and
+  exact; ``jax.image.resize`` uses half-pixel sampling which differs at
+  edges).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def coords_grid(batch: int, ht: int, wd: int, dtype=jnp.float32) -> jax.Array:
+    """Pixel-coordinate grid ``(B, H, W, 2)``, last axis ``(x, y)``."""
+    x = jnp.arange(wd, dtype=dtype)
+    y = jnp.arange(ht, dtype=dtype)
+    xx, yy = jnp.meshgrid(x, y)  # (H, W) each
+    grid = jnp.stack([xx, yy], axis=-1)  # (H, W, 2) -> (x, y)
+    return jnp.broadcast_to(grid[None], (batch, ht, wd, 2))
+
+
+def bilinear_sampler(img: jax.Array, coords: jax.Array,
+                     mask: bool = False):
+    """Sample ``img`` at pixel coordinates with zeros padding.
+
+    Args:
+      img: ``(B, H, W, C)``.
+      coords: ``(B, ..., 2)`` pixel coordinates, last axis ``(x, y)``.
+      mask: if True, also return an in-bounds indicator (strict inequalities,
+        matching reference utils.py:67-69: ``-1 < normalized < 1``).
+
+    Returns:
+      ``(B, ..., C)`` samples (and optionally the mask ``(B, ...)``).
+    """
+    B, H, W, C = img.shape
+    x = coords[..., 0]
+    y = coords[..., 1]
+
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    def gather(ix, iy):
+        # zeros padding: out-of-range corners contribute 0
+        valid = (ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1)
+        ixc = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+        flat = img.reshape(B, H * W, C)
+        idx = (iyc * W + ixc).reshape(B, -1)
+        out = jnp.take_along_axis(flat, idx[..., None], axis=1)
+        out = out.reshape(*ix.shape, C)
+        return out * valid[..., None].astype(img.dtype)
+
+    v00 = gather(x0, y0)
+    v01 = gather(x0 + 1, y0)
+    v10 = gather(x0, y0 + 1)
+    v11 = gather(x0 + 1, y0 + 1)
+
+    wx = wx[..., None].astype(img.dtype)
+    wy = wy[..., None].astype(img.dtype)
+    out = ((1 - wy) * ((1 - wx) * v00 + wx * v01)
+           + wy * ((1 - wx) * v10 + wx * v11))
+
+    if mask:
+        # Reference masks in normalized space with strict bounds
+        # (utils.py:67-69); equivalent pixel-space condition:
+        inb = (x > 0) & (x < W - 1) & (y > 0) & (y < H - 1)
+        return out, inb.astype(img.dtype)
+    return out
+
+
+@functools.lru_cache(maxsize=64)
+def _interp_matrix(src: int, dst: int) -> "np.ndarray":
+    """Dense ``(dst, src)`` align_corners=True bilinear interpolation matrix.
+
+    Built in numpy (not jnp) so the lru_cache holds host constants — caching
+    jax arrays would leak tracers when called under jit/scan/remat.
+    """
+    import numpy as np
+
+    if src == 1:
+        return np.ones((dst, 1), dtype=np.float32)
+    pos = np.arange(dst, dtype=np.float64) * (src - 1) / max(dst - 1, 1)
+    lo = np.clip(np.floor(pos), 0, src - 2).astype(np.int64)
+    frac = (pos - lo).astype(np.float32)
+    rows = np.arange(dst)
+    m = np.zeros((dst, src), dtype=np.float32)
+    m[rows, lo] += 1.0 - frac
+    m[rows, lo + 1] += frac
+    return m
+
+
+def resize_bilinear_align_corners(x: jax.Array, new_hw) -> jax.Array:
+    """``align_corners=True`` bilinear resize of ``(B, H, W, C)``.
+
+    Expressed as two dense matmuls (separable interpolation), which maps onto
+    the MXU instead of a gather — exact parity with
+    ``F.interpolate(..., mode='bilinear', align_corners=True)``.
+    """
+    B, H, W, C = x.shape
+    nh, nw = new_hw
+    mh = _interp_matrix(H, nh).astype(x.dtype)
+    mw = _interp_matrix(W, nw).astype(x.dtype)
+    hi = jax.lax.Precision.HIGHEST  # interpolation must be exact in fp32
+    out = jnp.einsum("ih,bhwc->biwc", mh, x, precision=hi)
+    out = jnp.einsum("jw,biwc->bijc", mw, out, precision=hi)
+    return out
+
+
+def upflow8(flow: jax.Array) -> jax.Array:
+    """8x upsample a flow field ``(B, H, W, 2)`` and scale values by 8."""
+    B, H, W, _ = flow.shape
+    return 8.0 * resize_bilinear_align_corners(flow, (8 * H, 8 * W))

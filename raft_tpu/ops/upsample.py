@@ -1,0 +1,49 @@
+"""Convex-combination flow upsampling (reference ``core/raft.py:72-83``).
+
+Each full-resolution pixel is a convex combination (softmax weights) of the
+3x3 coarse-grid neighborhood of its parent cell.  The reference implements
+this with ``F.unfold`` + reshapes in NCHW; here it is a single einsum over
+extracted patches in NHWC, which XLA fuses cleanly.
+
+Channel-order contract (for weight conversion parity): the mask produced by
+the update block has ``64 * 9`` channels which factorize as
+``(k, p, q) -> k * 64 + p * 8 + q`` where ``k`` indexes the 3x3 tap
+(row-major: k = (dy+1)*3 + (dx+1), matching ``F.unfold``'s (ki, kj) order)
+and ``(p, q)`` is the subpixel position (reference ``raft.py:75``:
+``mask.view(N, 1, 9, 8, 8, H, W)``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _extract_3x3_patches(x: jax.Array) -> jax.Array:
+    """``(B, H, W, C)`` -> ``(B, H, W, 9, C)``, taps in unfold order."""
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    H, W = x.shape[1], x.shape[2]
+    taps = []
+    for di in range(3):       # row offset (ki)
+        for dj in range(3):   # col offset (kj)
+            taps.append(xp[:, di:di + H, dj:dj + W, :])
+    return jnp.stack(taps, axis=3)
+
+
+def convex_upsample(flow: jax.Array, mask: jax.Array,
+                    factor: int = 8) -> jax.Array:
+    """Upsample ``(B, H, W, 2)`` flow to ``(B, 8H, 8W, 2)``.
+
+    Args:
+      flow: coarse flow in coarse-pixel units (scaled by ``factor`` inside,
+        reference ``raft.py:77``).
+      mask: ``(B, H, W, 9 * factor * factor)`` unnormalized weights.
+    """
+    B, H, W, _ = flow.shape
+    f = factor
+    m = mask.reshape(B, H, W, 9, f, f)
+    m = jax.nn.softmax(m, axis=3)
+
+    patches = _extract_3x3_patches(factor * flow)  # (B, H, W, 9, 2)
+    up = jnp.einsum("bhwkpq,bhwkc->bhpwqc", m, patches.astype(m.dtype))
+    return up.reshape(B, f * H, f * W, 2)

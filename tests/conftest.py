@@ -1,0 +1,20 @@
+"""Test env: force CPU with 8 virtual devices so multi-chip sharding logic
+is exercised without TPU hardware (SURVEY.md §4).
+
+Note: the axon TPU plugin's sitecustomize re-registers itself over
+``JAX_PLATFORMS``, so the env var alone is not enough — we must also update
+jax.config before any backend is initialized.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

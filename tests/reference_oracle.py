@@ -1,0 +1,39 @@
+"""Load the PyTorch reference (read-only, /root/reference) as a numerical
+test oracle.  The reference is UNTRUSTED third-party code used strictly to
+produce expected values for parity tests; nothing from it ships in
+raft_tpu.  Tests that need it must call ``skip_without_reference()``."""
+
+import pathlib
+import sys
+
+import pytest
+
+REF = pathlib.Path("/root/reference")
+
+
+def skip_without_reference():
+    if not REF.exists():
+        pytest.skip("reference repo not available")
+    try:
+        import torch  # noqa: F401
+    except ImportError:
+        pytest.skip("torch not available")
+
+
+def load_reference_core():
+    """Put the reference's ``core/`` on sys.path and import its modules."""
+    core = str(REF / "core")
+    if core not in sys.path:
+        sys.path.insert(0, core)
+    import corr as ref_corr            # noqa: F401
+    import extractor as ref_extractor  # noqa: F401
+    import raft as ref_raft            # noqa: F401
+    import update as ref_update        # noqa: F401
+    from utils import utils as ref_utils  # noqa: F401
+    return {
+        "corr": ref_corr,
+        "extractor": ref_extractor,
+        "raft": ref_raft,
+        "update": ref_update,
+        "utils": ref_utils,
+    }

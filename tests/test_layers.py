@@ -1,0 +1,48 @@
+"""Layer-level parity: conv padding/stride semantics vs torch (the
+reference's building blocks), and init statistics."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.models.layers import conv
+from tests.reference_oracle import skip_without_reference
+
+
+@pytest.mark.parametrize("kernel,stride,pad", [(7, 2, 3), (3, 2, 1),
+                                               (3, 1, 1), (1, 2, 0)])
+def test_conv_padding_matches_torch(kernel, stride, pad):
+    """XLA 'SAME' pads stride-2 convs asymmetrically; torch pads k//2 on
+    both sides.  The conv factory must reproduce torch exactly."""
+    skip_without_reference()
+    import torch
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 16, 20, 3)).astype(np.float32)
+    w = rng.normal(size=(kernel, kernel, 3, 8)).astype(np.float32)  # HWIO
+
+    layer = conv(8, kernel, stride)
+    out = layer.apply({"params": {"kernel": jnp.asarray(w),
+                                  "bias": jnp.zeros((8,))}}, jnp.asarray(x))
+
+    tx = torch.from_numpy(x).permute(0, 3, 1, 2)
+    tw = torch.from_numpy(np.transpose(w, (3, 2, 0, 1)))  # OIHW
+    ref = F.conv2d(tx, tw, stride=stride, padding=pad)
+    ref = ref.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+
+def test_torch_default_init_statistics():
+    """torch_default_init weights/biases ~ U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+    layer = conv(64, 3, 1, torch_default_init=True, in_features=32)
+    params = layer.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 32)))
+    w = np.asarray(params["params"]["kernel"])
+    b = np.asarray(params["params"]["bias"])
+    bound = 1.0 / np.sqrt(32 * 9)
+    assert np.abs(w).max() <= bound + 1e-6
+    assert np.abs(b).max() <= bound + 1e-6
+    # roughly uniform: std of U(-b, b) is b/sqrt(3)
+    assert abs(w.std() - bound / np.sqrt(3)) < 0.05 * bound

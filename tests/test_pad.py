@@ -1,0 +1,36 @@
+"""InputPadder tests (reference utils.py:7-24 semantics)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from raft_tpu.ops import InputPadder
+
+
+def test_pad_to_multiple_of_8_sintel_centered():
+    x = jnp.ones((1, 436, 1024, 3))
+    padder = InputPadder(x.shape, mode="sintel")
+    y = padder.pad(x)
+    assert y.shape == (1, 440, 1024, 3)
+    # height pad 4 -> 2 top, 2 bottom (centered)
+    back = padder.unpad(y)
+    assert back.shape == x.shape
+
+
+def test_pad_kitti_bottom_only():
+    x = jnp.arange(2 * 370 * 1226 * 1, dtype=jnp.float32).reshape(2, 370, 1226, 1)
+    padder = InputPadder(x.shape, mode="kitti")
+    y = padder.pad(x)
+    assert y.shape == (2, 376, 1232, 1)
+    # top row unchanged (no top pad in non-sintel mode)
+    np.testing.assert_array_equal(np.asarray(y)[:, 0, 3:-3, :],
+                                  np.asarray(x)[:, 0, :, :])
+    back = padder.unpad(y)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_already_divisible_no_pad():
+    x = jnp.ones((1, 64, 128, 3))
+    padder = InputPadder(x.shape)
+    y = padder.pad(x)
+    assert y.shape == x.shape

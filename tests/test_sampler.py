@@ -1,0 +1,85 @@
+"""Parity tests for raft_tpu.ops.sampler against the PyTorch reference
+semantics (grid_sample align_corners=True, zeros padding)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.ops import (
+    bilinear_sampler,
+    coords_grid,
+    resize_bilinear_align_corners,
+    upflow8,
+)
+from tests.reference_oracle import skip_without_reference
+
+
+def test_coords_grid_values():
+    g = np.asarray(coords_grid(2, 3, 4))
+    assert g.shape == (2, 3, 4, 2)
+    # last axis is (x, y)
+    assert np.array_equal(g[0, :, :, 0], np.tile(np.arange(4), (3, 1)))
+    assert np.array_equal(g[0, :, :, 1], np.tile(np.arange(3)[:, None], (1, 4)))
+    assert np.array_equal(g[0], g[1])
+
+
+def test_bilinear_sampler_exact_integer_coords():
+    rng = np.random.default_rng(0)
+    img = rng.normal(size=(1, 5, 7, 3)).astype(np.float32)
+    # integer coords must return exact pixels
+    coords = np.stack(np.meshgrid(np.arange(7), np.arange(5)), axis=-1)
+    coords = coords[None].astype(np.float32)  # (1, 5, 7, 2) (x, y)
+    out = np.asarray(bilinear_sampler(jnp.asarray(img), jnp.asarray(coords)))
+    np.testing.assert_allclose(out, img, rtol=1e-6)
+
+
+def test_bilinear_sampler_vs_torch_grid_sample():
+    skip_without_reference()
+    import torch
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(1)
+    img = rng.normal(size=(2, 9, 13, 4)).astype(np.float32)
+    # coords include out-of-bounds on purpose
+    coords = rng.uniform(-3, 16, size=(2, 6, 5, 2)).astype(np.float32)
+
+    out = np.asarray(bilinear_sampler(jnp.asarray(img), jnp.asarray(coords)))
+
+    timg = torch.from_numpy(img).permute(0, 3, 1, 2)  # NCHW
+    H, W = 9, 13
+    x = torch.from_numpy(coords[..., 0]) * 2 / (W - 1) - 1
+    y = torch.from_numpy(coords[..., 1]) * 2 / (H - 1) - 1
+    grid = torch.stack([x, y], dim=-1)
+    ref = F.grid_sample(timg, grid, align_corners=True, padding_mode="zeros")
+    ref = ref.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_bilinear_sampler_mask():
+    img = jnp.ones((1, 4, 4, 1))
+    coords = jnp.array([[[[0.0, 0.0], [1.5, 1.5], [3.5, 2.0], [-1.0, 1.0]]]])
+    _, mask = bilinear_sampler(img, coords, mask=True)
+    # strict bounds: 0 is NOT in-bounds (matches reference utils.py:67-69)
+    np.testing.assert_array_equal(np.asarray(mask)[0, 0], [0.0, 1.0, 0.0, 0.0])
+
+
+def test_resize_align_corners_vs_torch():
+    skip_without_reference()
+    import torch
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2, 6, 7, 3)).astype(np.float32)
+    out = np.asarray(resize_bilinear_align_corners(jnp.asarray(x), (48, 56)))
+    t = torch.from_numpy(x).permute(0, 3, 1, 2)
+    ref = F.interpolate(t, size=(48, 56), mode="bilinear", align_corners=True)
+    ref = ref.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_upflow8_scales_and_interpolates():
+    flow = jnp.ones((1, 4, 5, 2)) * 2.0
+    up = np.asarray(upflow8(flow))
+    assert up.shape == (1, 32, 40, 2)
+    np.testing.assert_allclose(up, 16.0, rtol=1e-6)

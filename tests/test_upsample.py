@@ -1,0 +1,48 @@
+"""Convex-upsample tests: analytic invariants + parity with the reference's
+RAFT.upsample_flow (raft.py:72-83)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from raft_tpu.ops import convex_upsample
+from tests.reference_oracle import skip_without_reference, load_reference_core
+
+
+def test_constant_flow_stays_constant_interior():
+    """Convex combination of a constant field is the same constant (x8) away
+    from the borders (border cells mix in zero-padded taps, same as the
+    reference's F.unfold(padding=1))."""
+    rng = np.random.default_rng(0)
+    flow = np.ones((2, 4, 6, 2), np.float32) * np.array([1.5, -2.0], np.float32)
+    mask = rng.normal(size=(2, 4, 6, 9 * 64)).astype(np.float32)
+    up = np.asarray(convex_upsample(jnp.asarray(flow), jnp.asarray(mask)))
+    assert up.shape == (2, 32, 48, 2)
+    interior = up[:, 8:-8, 8:-8, :]
+    np.testing.assert_allclose(interior[..., 0], 12.0, atol=1e-4)
+    np.testing.assert_allclose(interior[..., 1], -16.0, atol=1e-4)
+
+
+def test_vs_reference_upsample_flow():
+    skip_without_reference()
+    import argparse
+    import torch
+    ref = load_reference_core()
+
+    args = argparse.Namespace(small=False, dropout=0.0,
+                              alternate_corr=False, mixed_precision=False)
+    model = ref["raft"].RAFT(args)
+
+    rng = np.random.default_rng(1)
+    B, H, W = 2, 5, 7
+    flow = rng.normal(size=(B, H, W, 2)).astype(np.float32) * 3
+    mask = rng.normal(size=(B, H, W, 9 * 64)).astype(np.float32)
+
+    tflow = torch.from_numpy(np.transpose(flow, (0, 3, 1, 2)))
+    tmask = torch.from_numpy(np.transpose(mask, (0, 3, 1, 2)))
+    with torch.no_grad():
+        expected = model.upsample_flow(tflow, tmask)
+    expected = expected.permute(0, 2, 3, 1).numpy()
+
+    got = np.asarray(convex_upsample(jnp.asarray(flow), jnp.asarray(mask)))
+    np.testing.assert_allclose(got, expected, atol=1e-4)
