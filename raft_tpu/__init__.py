@@ -11,11 +11,25 @@ designed TPU-first:
   the reference's CUDA scatter kernel.
 - Data parallelism is SPMD over a ``jax.sharding.Mesh`` with psum gradient
   all-reduce over ICI, replacing ``nn.DataParallel``.
+
+Model classes import jax/flax; they are loaded lazily so that host-side
+subsystems (``raft_tpu.data``) stay importable in data-loader worker
+processes without paying the jax import or touching backend state.
 """
 
-from raft_tpu.config import RAFTConfig
-from raft_tpu.models.raft import RAFT
+from raft_tpu.config import RAFTConfig, TrainConfig
 
 __version__ = "0.1.0"
 
-__all__ = ["RAFT", "RAFTConfig", "__version__"]
+__all__ = ["RAFT", "RAFTConfig", "TrainConfig", "__version__"]
+
+_LAZY = {"RAFT": ("raft_tpu.models.raft", "RAFT")}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

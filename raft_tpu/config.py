@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
-import jax.numpy as jnp
+import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,7 +63,15 @@ class RAFTConfig:
 
     @property
     def dtype(self):
-        return jnp.dtype(self.compute_dtype)
+        # np.dtype understands 'bfloat16' once jax/ml_dtypes is loaded;
+        # resolve lazily so importing config (and raft_tpu.data) stays
+        # jax-free in data-loader workers.
+        try:
+            return np.dtype(self.compute_dtype)
+        except TypeError:
+            import jax.numpy as jnp
+
+            return jnp.dtype(self.compute_dtype)
 
     def replace(self, **kw) -> "RAFTConfig":
         return dataclasses.replace(self, **kw)

@@ -1,0 +1,13 @@
+"""Host-side data subsystem: file formats, datasets, augmentation, loading."""
+
+from raft_tpu.data.frame_utils import (  # noqa: F401
+    read_disp_kitti,
+    read_flo,
+    read_flow_kitti,
+    read_gen,
+    read_image,
+    read_pfm,
+    write_flo,
+    write_flow_kitti,
+)
+from raft_tpu.data.png16 import read_png, write_png  # noqa: F401

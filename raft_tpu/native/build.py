@@ -1,0 +1,60 @@
+"""Build + load the native host-runtime library.
+
+Compiles ``raft_tpu/native/*.c`` into ``_raftnative.so`` on first use (cc is
+in the image; the build is one translation unit and takes well under a
+second), caches by source mtime, and exposes the handle via ctypes.  Every
+caller must degrade gracefully when no compiler is available — the NumPy
+fallbacks stay correct, just slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_raftnative.so")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_FAILED = False
+
+_SOURCES = ["png_filters.c"]
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    return any(
+        os.path.getmtime(os.path.join(_DIR, s)) > so_mtime for s in _SOURCES)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Return the native library, building it if needed; None if
+    unavailable (no compiler / build failure)."""
+    global _LIB, _FAILED
+    if _LIB is not None or _FAILED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _FAILED:
+            return _LIB
+        try:
+            if _needs_build():
+                srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+                tmp = _SO + f".tmp.{os.getpid()}"
+                subprocess.run(
+                    ["cc", "-O2", "-shared", "-fPIC", "-o", tmp, *srcs],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _SO)  # atomic wrt concurrent workers
+            lib = ctypes.CDLL(_SO)
+            lib.png_unfilter.restype = ctypes.c_int
+            lib.png_unfilter.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_long, ctypes.c_long, ctypes.c_int]
+            _LIB = lib
+        except (OSError, subprocess.SubprocessError):
+            _FAILED = True
+        return _LIB

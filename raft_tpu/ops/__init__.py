@@ -12,7 +12,6 @@ from raft_tpu.ops.corr import (
     corr_lookup,
     chunked_corr_lookup,
 )
-
 __all__ = [
     "bilinear_sampler",
     "coords_grid",

@@ -1,0 +1,11 @@
+"""Host-side utilities: pure NumPy/SciPy, no jax import.
+
+Device-side tensor ops live in :mod:`raft_tpu.ops`; these run on the host
+(visualization, CPU warm-start warping) and stay importable in data-loader
+worker processes without touching jax backend state.
+"""
+
+from raft_tpu.utils.flow_viz import flow_to_image, make_colorwheel  # noqa: F401
+from raft_tpu.utils.warp import forward_interpolate  # noqa: F401
+
+__all__ = ["flow_to_image", "make_colorwheel", "forward_interpolate"]

@@ -1,0 +1,72 @@
+"""Flow-field visualization: Baker et al. (ICCV'07) color wheel.
+
+Parity with the reference ``core/utils/flow_viz.py`` (C11), but fully
+vectorized — the reference interpolates the wheel one RGB channel at a time
+in a Python loop (flow_viz.py:95-105); here one gather + lerp over all
+channels.  Output is bit-exact with the reference for identical inputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_colorwheel() -> np.ndarray:
+    """55-color RY/YG/GC/CB/BM/MR wheel -> ``(55, 3)`` float64
+    (reference flow_viz.py:20-67)."""
+    transitions = (15, 6, 4, 11, 13, 6)  # RY YG GC CB BM MR
+    ncols = sum(transitions)
+    wheel = np.zeros((ncols, 3))
+    col = 0
+    # Each segment ramps one channel while another is held at 255; the hue
+    # cycle is R->Y->G->C->B->M->R.
+    for (n, (hold, ramp, down)) in zip(
+            transitions,
+            [(0, 1, False), (1, 0, True), (1, 2, False),
+             (2, 1, True), (2, 0, False), (0, 2, True)]):
+        ramp_vals = np.floor(255 * np.arange(n) / n)
+        wheel[col:col + n, hold] = 255
+        wheel[col:col + n, ramp] = 255 - ramp_vals if down else ramp_vals
+        col += n
+    return wheel
+
+
+_WHEEL = make_colorwheel()
+
+
+def flow_uv_to_colors(u: np.ndarray, v: np.ndarray,
+                      convert_to_bgr: bool = False) -> np.ndarray:
+    """Map normalized flow components to wheel colors
+    (reference flow_viz.py:70-106).  ``u``/``v`` are ``(H, W)`` with
+    magnitude <= 1 mapping inside the wheel."""
+    ncols = _WHEEL.shape[0]
+    rad = np.sqrt(u * u + v * v)
+    a = np.arctan2(-v, -u) / np.pi
+    fk = (a + 1) / 2 * (ncols - 1)
+    k0 = np.floor(fk).astype(np.int32)
+    k1 = np.where(k0 + 1 == ncols, 0, k0 + 1)
+    f = (fk - k0)[..., None]
+    # Divide before the lerp: floor(255*col) is sensitive to the last ulp.
+    col = (1 - f) * (_WHEEL[k0] / 255.0) + f * (_WHEEL[k1] / 255.0)
+    inside = (rad <= 1)[..., None]
+    col = np.where(inside, 1 - rad[..., None] * (1 - col), col * 0.75)
+    img = np.floor(255 * col).astype(np.uint8)
+    return img[..., ::-1] if convert_to_bgr else img
+
+
+def flow_to_image(flow_uv: np.ndarray, clip_flow: float = None,
+                  convert_to_bgr: bool = False) -> np.ndarray:
+    """``(H, W, 2)`` flow -> ``(H, W, 3)`` uint8 visualization, normalized
+    by the max radius (reference flow_viz.py:109-132).
+
+    ``clip_flow`` clips to ``[-clip_flow, clip_flow]`` — this deviates from
+    the reference, whose ``np.clip(flow_uv, 0, clip_flow)`` silently zeroes
+    all negative (left/up) motion."""
+    flow_uv = np.asarray(flow_uv)
+    assert flow_uv.ndim == 3 and flow_uv.shape[2] == 2, flow_uv.shape
+    if clip_flow is not None:
+        flow_uv = np.clip(flow_uv, -clip_flow, clip_flow)
+    u, v = flow_uv[..., 0], flow_uv[..., 1]
+    rad_max = np.sqrt(u * u + v * v).max()
+    scale = 1.0 / (rad_max + 1e-5)
+    return flow_uv_to_colors(u * scale, v * scale, convert_to_bgr)

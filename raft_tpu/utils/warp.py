@@ -1,0 +1,40 @@
+"""Host-side flow warping utilities.
+
+``forward_interpolate`` forward-warps a flow field to seed the next frame's
+estimate — the Sintel-submission warm start (reference
+``core/utils/utils.py:26-54``, used at ``evaluate.py:40-41``).  The reference
+calls ``scipy.interpolate.griddata(method='nearest')`` twice; internally that
+is a cKDTree nearest-neighbor query, so we build the tree once and query once
+for both channels — same result, half the work.  Runs on host (NumPy): the
+scattered-data structure is irregular and belongs on CPU, not under jit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.spatial import cKDTree
+
+
+def forward_interpolate(flow: np.ndarray) -> np.ndarray:
+    """Forward-warp ``(H, W, 2)`` flow along itself via nearest-neighbor
+    resampling of the scattered targets.  Returns ``(H, W, 2)`` float32."""
+    flow = np.asarray(flow, np.float32)
+    assert flow.ndim == 3 and flow.shape[2] == 2, flow.shape
+    h, w, _ = flow.shape
+    dx, dy = flow[..., 0], flow[..., 1]
+
+    x0, y0 = np.meshgrid(np.arange(w), np.arange(h))
+    x1 = (x0 + dx).ravel()
+    y1 = (y0 + dy).ravel()
+
+    valid = (x1 > 0) & (x1 < w) & (y1 > 0) & (y1 < h)
+    if not valid.any():
+        return np.zeros_like(flow)
+
+    pts = np.stack([x1[valid], y1[valid]], axis=-1)
+    vals = flow.reshape(-1, 2)[valid]
+
+    tree = cKDTree(pts)
+    _, idx = tree.query(
+        np.stack([x0.ravel(), y0.ravel()], axis=-1).astype(np.float32))
+    return vals[idx].reshape(h, w, 2).astype(np.float32)

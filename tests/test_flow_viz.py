@@ -1,0 +1,80 @@
+"""Color-wheel visualization vs an independent straight-line reimplementation
+of the published algorithm (SURVEY C11)."""
+
+import numpy as np
+
+from raft_tpu.utils import flow_viz
+
+
+def _naive_wheel():
+    # Direct transcription of the Baker et al. wheel construction.
+    RY, YG, GC, CB, BM, MR = 15, 6, 4, 11, 13, 6
+    n = RY + YG + GC + CB + BM + MR
+    w = np.zeros((n, 3))
+    c = 0
+    w[c:c + RY, 0] = 255
+    w[c:c + RY, 1] = np.floor(255 * np.arange(RY) / RY)
+    c += RY
+    w[c:c + YG, 0] = 255 - np.floor(255 * np.arange(YG) / YG)
+    w[c:c + YG, 1] = 255
+    c += YG
+    w[c:c + GC, 1] = 255
+    w[c:c + GC, 2] = np.floor(255 * np.arange(GC) / GC)
+    c += GC
+    w[c:c + CB, 1] = 255 - np.floor(255 * np.arange(CB) / CB)
+    w[c:c + CB, 2] = 255
+    c += CB
+    w[c:c + BM, 2] = 255
+    w[c:c + BM, 0] = np.floor(255 * np.arange(BM) / BM)
+    c += BM
+    w[c:c + MR, 2] = 255 - np.floor(255 * np.arange(MR) / MR)
+    w[c:c + MR, 0] = 255
+    return w
+
+
+def _naive_colors(u, v):
+    wheel = _naive_wheel()
+    ncols = wheel.shape[0]
+    img = np.zeros(u.shape + (3,), np.uint8)
+    rad = np.sqrt(u ** 2 + v ** 2)
+    a = np.arctan2(-v, -u) / np.pi
+    fk = (a + 1) / 2 * (ncols - 1)
+    k0 = np.floor(fk).astype(int)
+    k1 = k0 + 1
+    k1[k1 == ncols] = 0
+    f = fk - k0
+    for i in range(3):
+        col0 = wheel[k0, i] / 255.0
+        col1 = wheel[k1, i] / 255.0
+        col = (1 - f) * col0 + f * col1
+        idx = rad <= 1
+        col[idx] = 1 - rad[idx] * (1 - col[idx])
+        col[~idx] = col[~idx] * 0.75
+        img[..., i] = np.floor(255 * col)
+    return img
+
+
+def test_wheel_matches_naive():
+    np.testing.assert_array_equal(flow_viz.make_colorwheel(), _naive_wheel())
+
+
+def test_colors_match_naive():
+    rng = np.random.RandomState(0)
+    u = rng.randn(16, 16) * 1.2   # includes out-of-wheel radii
+    v = rng.randn(16, 16) * 1.2
+    np.testing.assert_array_equal(
+        flow_viz.flow_uv_to_colors(u, v), _naive_colors(u, v))
+
+
+def test_flow_to_image_properties():
+    flow = np.zeros((8, 8, 2), np.float32)
+    img = flow_viz.flow_to_image(flow)
+    assert img.shape == (8, 8, 3) and img.dtype == np.uint8
+    # Zero flow maps to (near-)white wheel center.
+    assert (img > 250).all()
+    bgr = flow_viz.flow_to_image(
+        np.random.RandomState(1).randn(8, 8, 2).astype(np.float32),
+        convert_to_bgr=True)
+    rgb = flow_viz.flow_to_image(
+        np.random.RandomState(1).randn(8, 8, 2).astype(np.float32))
+    np.testing.assert_array_equal(bgr[..., ::-1], rgb)

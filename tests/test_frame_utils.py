@@ -1,0 +1,101 @@
+"""File-format I/O: .flo / .pfm / KITTI PNG round trips (SURVEY C10)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.data import frame_utils as fu
+from raft_tpu.data import png16
+
+
+def test_flo_roundtrip(tmp_path):
+    flow = np.random.RandomState(0).randn(13, 17, 2).astype(np.float32)
+    p = str(tmp_path / "a.flo")
+    fu.write_flo(p, flow)
+    np.testing.assert_array_equal(fu.read_flo(p), flow)
+
+
+def test_flo_bad_magic(tmp_path):
+    p = tmp_path / "bad.flo"
+    p.write_bytes(b"\x00" * 32)
+    with pytest.raises(ValueError):
+        fu.read_flo(str(p))
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.uint16])
+@pytest.mark.parametrize("nch", [1, 3, 4])
+def test_png_roundtrip(tmp_path, dtype, nch):
+    rng = np.random.RandomState(1)
+    hi = 255 if dtype == np.uint8 else 65535
+    shape = (11, 7) if nch == 1 else (11, 7, nch)
+    img = rng.randint(0, hi + 1, size=shape).astype(dtype)
+    p = str(tmp_path / "x.png")
+    png16.write_png(p, img)
+    np.testing.assert_array_equal(png16.read_png(p), img)
+
+
+def test_png_reader_matches_pil_on_filtered_files(tmp_path):
+    # PIL writes adaptively-filtered PNGs (filters 1-4) — exercise the
+    # sequential unfilter paths in our decoder against PIL's own reading.
+    from PIL import Image
+    rng = np.random.RandomState(2)
+    # A smooth gradient image encourages Sub/Up/Paeth filters.
+    g = np.add.outer(np.arange(33), np.arange(47)) % 256
+    img = np.stack([g, g[::-1], rng.randint(0, 256, g.shape)],
+                   axis=-1).astype(np.uint8)
+    p = str(tmp_path / "pil.png")
+    Image.fromarray(img).save(p)
+    np.testing.assert_array_equal(png16.read_png(p), np.array(Image.open(p)))
+
+
+def test_native_unfilter_matches_numpy(tmp_path):
+    # PIL emits adaptively-filtered rows (Sub/Up/Average/Paeth); the C
+    # unfilter and the NumPy fallback must agree byte-for-byte.
+    from PIL import Image
+    from raft_tpu.native import build as nb
+    rng = np.random.RandomState(7)
+    g = (np.add.outer(np.arange(21), np.arange(33)) % 256).astype(np.uint8)
+    img = np.stack([g, g[::-1], rng.randint(0, 256, g.shape, np.uint8)], -1)
+    p = str(tmp_path / "adaptive.png")
+    Image.fromarray(img).save(p)
+    native = png16.read_png(p)
+    saved_lib, saved_failed = nb._LIB, nb._FAILED
+    nb._LIB, nb._FAILED = None, True  # force NumPy fallback
+    try:
+        fallback = png16.read_png(p)
+    finally:
+        nb._LIB, nb._FAILED = saved_lib, saved_failed
+    np.testing.assert_array_equal(native, fallback)
+    np.testing.assert_array_equal(native, img)
+
+
+def test_kitti_flow_roundtrip(tmp_path):
+    rng = np.random.RandomState(3)
+    flow = (rng.rand(9, 12, 2).astype(np.float32) - 0.5) * 100
+    p = str(tmp_path / "k.png")
+    fu.write_flow_kitti(p, flow)
+    back, valid = fu.read_flow_kitti(p)
+    # Quantization step is 1/64 px.
+    assert np.abs(back - flow).max() <= 1.0 / 64 + 1e-6
+    assert (valid == 1).all()
+
+
+def test_pfm_roundtrip_both_endian(tmp_path):
+    rng = np.random.RandomState(4)
+    data = rng.rand(6, 5, 3).astype(np.float32)
+    for scale, order in [("-1.0", "<f4"), ("1.0", ">f4")]:
+        p = tmp_path / f"s{scale}.pfm"
+        with open(p, "wb") as f:
+            f.write(b"PF\n5 6\n" + scale.encode() + b"\n")
+            np.flipud(data).astype(order).tofile(f)
+        np.testing.assert_allclose(fu.read_pfm(str(p)), data, rtol=1e-6)
+
+
+def test_read_gen_dispatch(tmp_path):
+    flow = np.zeros((4, 4, 2), np.float32)
+    p = str(tmp_path / "f.flo")
+    fu.write_flo(p, flow)
+    assert fu.read_gen(p).shape == (4, 4, 2)
+    from PIL import Image
+    ip = str(tmp_path / "i.png")
+    Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(ip)
+    assert fu.read_gen(ip).shape == (4, 4, 3)
