@@ -11,3 +11,19 @@ from raft_tpu.data.frame_utils import (  # noqa: F401
     write_flow_kitti,
 )
 from raft_tpu.data.png16 import read_png, write_png  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy: datasets/augment pull in cv2; keep bare `import raft_tpu.data`
+    # light for codec-only users.
+    _lazy = {
+        "FlowDataset", "ConcatFlowDataset", "MpiSintel", "FlyingChairs",
+        "FlyingThings3D", "KITTI", "HD1K", "ShardedLoader", "fetch_dataset",
+    }
+    if name in _lazy:
+        from raft_tpu.data import datasets as _d
+        return getattr(_d, name)
+    if name in {"FlowAugmentor", "SparseFlowAugmentor", "ColorJitter"}:
+        from raft_tpu.data import augment as _a
+        return getattr(_a, name)
+    raise AttributeError(name)

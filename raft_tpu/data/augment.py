@@ -1,0 +1,281 @@
+"""Host-side data augmentation (NumPy/cv2/PIL; no torch).
+
+Behavioral parity with the reference ``core/utils/augmentor.py`` (C9 in
+SURVEY.md): photometric color jitter (asymmetric w.p. 0.2 for dense data,
+augmentor.py:40-48), occlusion "eraser" rectangles on frame 2
+(augmentor.py:52-65), random scale with independent x/y stretch
+(augmentor.py:67-89), h/v flips with flow sign fixes (augmentor.py:91-100),
+random crop (augmentor.py:102-107), and the sparse variant's
+nearest-neighbor flow-map rescale (augmentor.py:161-193).
+
+TPU-first redesign choices:
+
+- All randomness flows through an explicit ``np.random.Generator`` instead
+  of global ``np.random`` state, so augmentation is deterministic per
+  ``(seed, host, step, sample)`` — reproducible across pod restarts and
+  shardable across hosts without the reference's per-worker reseed hack
+  (datasets.py:45-51).
+- The torchvision ``ColorJitter`` dependency is replaced by a NumPy/PIL
+  implementation with the same parameterization (brightness/contrast/
+  saturation factors ~ U[1-x, 1+x], hue shift ~ U[-h, h], applied in a
+  random order, matching torchvision semantics for PIL inputs).
+- Layout is NHWC throughout (TPU-native); images stay uint8 until batching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+import cv2
+
+cv2.setNumThreads(0)  # decode/augment parallelism is ours, not cv2's
+try:
+    cv2.ocl.setUseOpenCL(False)
+except AttributeError:  # minimal cv2 builds
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Photometric jitter (torchvision ColorJitter equivalent)
+# ---------------------------------------------------------------------------
+
+def _adjust_brightness(img: np.ndarray, factor: float) -> np.ndarray:
+    # PIL ImageEnhance.Brightness: blend with black.
+    return np.clip(img.astype(np.float32) * factor, 0, 255).astype(np.uint8)
+
+
+def _adjust_contrast(img: np.ndarray, factor: float) -> np.ndarray:
+    # PIL ImageEnhance.Contrast: blend with the mean-gray image; PIL uses
+    # int(round(mean of L channel)).
+    gray = cv2.cvtColor(img, cv2.COLOR_RGB2GRAY)
+    mean = round(float(gray.mean()))
+    out = img.astype(np.float32) * factor + mean * (1.0 - factor)
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def _adjust_saturation(img: np.ndarray, factor: float) -> np.ndarray:
+    # PIL ImageEnhance.Color: blend with the grayscale image.
+    gray = cv2.cvtColor(img, cv2.COLOR_RGB2GRAY)[..., None].astype(np.float32)
+    out = img.astype(np.float32) * factor + gray * (1.0 - factor)
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def _adjust_hue(img: np.ndarray, shift: float) -> np.ndarray:
+    # shift in [-0.5, 0.5] turns of the hue circle (torchvision convention).
+    hsv = cv2.cvtColor(img, cv2.COLOR_RGB2HSV)
+    h = hsv[..., 0].astype(np.int16)  # cv2 uint8 hue is [0, 180)
+    h = (h + int(round(shift * 180.0))) % 180
+    hsv[..., 0] = h.astype(np.uint8)
+    return cv2.cvtColor(hsv, cv2.COLOR_HSV2RGB)
+
+
+@dataclasses.dataclass(frozen=True)
+class ColorJitter:
+    """Photometric jitter with torchvision's parameter conventions
+    (reference augmentor.py:32,138)."""
+
+    brightness: float = 0.4
+    contrast: float = 0.4
+    saturation: float = 0.4
+    hue: float = 0.5 / 3.14
+
+    def __call__(self, rng: np.random.Generator, img: np.ndarray) -> np.ndarray:
+        factors = {
+            "brightness": rng.uniform(max(0.0, 1 - self.brightness),
+                                      1 + self.brightness),
+            "contrast": rng.uniform(max(0.0, 1 - self.contrast),
+                                    1 + self.contrast),
+            "saturation": rng.uniform(max(0.0, 1 - self.saturation),
+                                      1 + self.saturation),
+            "hue": rng.uniform(-self.hue, self.hue),
+        }
+        ops = ["brightness", "contrast", "saturation", "hue"]
+        for name in rng.permutation(ops):
+            f = factors[str(name)]
+            if name == "brightness":
+                img = _adjust_brightness(img, f)
+            elif name == "contrast":
+                img = _adjust_contrast(img, f)
+            elif name == "saturation":
+                img = _adjust_saturation(img, f)
+            else:
+                img = _adjust_hue(img, f)
+        return img
+
+
+# ---------------------------------------------------------------------------
+# Dense augmentor
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FlowAugmentor:
+    """Dense-flow augmentation (reference FlowAugmentor, augmentor.py:15-120).
+
+    ``crop_size`` is ``(H, W)``.  Scale is ``2**U(min_scale, max_scale)``
+    with independent x/y stretch w.p. 0.8 (augmentor.py:74-82); the scale is
+    clamped so the result fits ``crop + 8`` pixels (augmentor.py:70-72).
+    """
+
+    crop_size: Tuple[int, int]
+    min_scale: float = -0.2
+    max_scale: float = 0.5
+    do_flip: bool = True
+    spatial_aug_prob: float = 0.8
+    stretch_prob: float = 0.8
+    max_stretch: float = 0.2
+    h_flip_prob: float = 0.5
+    v_flip_prob: float = 0.1
+    asymmetric_color_aug_prob: float = 0.2
+    eraser_aug_prob: float = 0.5
+    jitter: ColorJitter = dataclasses.field(default_factory=ColorJitter)
+
+    def color_transform(self, rng, img1, img2):
+        if rng.random() < self.asymmetric_color_aug_prob:
+            return self.jitter(rng, img1), self.jitter(rng, img2)
+        stack = np.concatenate([img1, img2], axis=0)
+        stack = self.jitter(rng, stack)
+        return np.split(stack, 2, axis=0)
+
+    def eraser_transform(self, rng, img1, img2, bounds=(50, 100)):
+        ht, wd = img1.shape[:2]
+        if rng.random() < self.eraser_aug_prob:
+            img2 = img2.copy()
+            mean_color = img2.reshape(-1, 3).mean(axis=0)
+            for _ in range(rng.integers(1, 3)):
+                x0 = int(rng.integers(0, wd))
+                y0 = int(rng.integers(0, ht))
+                dx = int(rng.integers(bounds[0], bounds[1]))
+                dy = int(rng.integers(bounds[0], bounds[1]))
+                img2[y0:y0 + dy, x0:x0 + dx, :] = mean_color
+        return img1, img2
+
+    def _sample_scales(self, rng, ht, wd, pad):
+        floor = max((self.crop_size[0] + pad) / float(ht),
+                    (self.crop_size[1] + pad) / float(wd))
+        scale = 2.0 ** rng.uniform(self.min_scale, self.max_scale)
+        sx = sy = scale
+        if rng.random() < self.stretch_prob:
+            sx *= 2.0 ** rng.uniform(-self.max_stretch, self.max_stretch)
+            sy *= 2.0 ** rng.uniform(-self.max_stretch, self.max_stretch)
+        return max(sx, floor), max(sy, floor)
+
+    def spatial_transform(self, rng, img1, img2, flow):
+        ht, wd = img1.shape[:2]
+        sx, sy = self._sample_scales(rng, ht, wd, pad=8)
+
+        if rng.random() < self.spatial_aug_prob:
+            img1 = cv2.resize(img1, None, fx=sx, fy=sy,
+                              interpolation=cv2.INTER_LINEAR)
+            img2 = cv2.resize(img2, None, fx=sx, fy=sy,
+                              interpolation=cv2.INTER_LINEAR)
+            flow = cv2.resize(flow, None, fx=sx, fy=sy,
+                              interpolation=cv2.INTER_LINEAR)
+            flow = flow * [sx, sy]
+
+        if self.do_flip:
+            if rng.random() < self.h_flip_prob:
+                img1 = img1[:, ::-1]
+                img2 = img2[:, ::-1]
+                flow = flow[:, ::-1] * [-1.0, 1.0]
+            if rng.random() < self.v_flip_prob:
+                img1 = img1[::-1, :]
+                img2 = img2[::-1, :]
+                flow = flow[::-1, :] * [1.0, -1.0]
+
+        y0 = int(rng.integers(0, img1.shape[0] - self.crop_size[0]))
+        x0 = int(rng.integers(0, img1.shape[1] - self.crop_size[1]))
+        sl = np.s_[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        return img1[sl], img2[sl], flow[sl]
+
+    def __call__(self, rng: np.random.Generator, img1, img2, flow):
+        img1, img2 = self.color_transform(rng, img1, img2)
+        img1, img2 = self.eraser_transform(rng, img1, img2)
+        img1, img2, flow = self.spatial_transform(rng, img1, img2, flow)
+        return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),
+                np.ascontiguousarray(flow.astype(np.float32)))
+
+
+# ---------------------------------------------------------------------------
+# Sparse augmentor (KITTI / HD1K)
+# ---------------------------------------------------------------------------
+
+def resize_sparse_flow_map(flow, valid, fx=1.0, fy=1.0):
+    """Rescale a sparse flow field by scattering valid samples into the
+    resized grid at rounded coordinates (reference augmentor.py:161-193 —
+    bilinear resize would corrupt flow at valid/invalid boundaries)."""
+    ht, wd = flow.shape[:2]
+    ys, xs = np.nonzero(valid >= 1)
+    flow0 = flow[ys, xs].astype(np.float32)
+
+    ht1 = int(round(ht * fy))
+    wd1 = int(round(wd * fx))
+    xx = np.round(xs * fx).astype(np.int32)
+    yy = np.round(ys * fy).astype(np.int32)
+    flow1 = flow0 * [fx, fy]
+
+    keep = (xx > 0) & (xx < wd1) & (yy > 0) & (yy < ht1)
+    flow_img = np.zeros([ht1, wd1, 2], dtype=np.float32)
+    valid_img = np.zeros([ht1, wd1], dtype=np.int32)
+    flow_img[yy[keep], xx[keep]] = flow1[keep]
+    valid_img[yy[keep], xx[keep]] = 1
+    return flow_img, valid_img
+
+
+@dataclasses.dataclass
+class SparseFlowAugmentor(FlowAugmentor):
+    """Sparse-flow augmentation for KITTI/HD1K (reference
+    SparseFlowAugmentor, augmentor.py:122-246): symmetric-only color with
+    softer jitter, no stretch, h-flip only, crop offsets biased past the
+    image border by (20, 50) margins then clamped (augmentor.py:220-227)."""
+
+    do_flip: bool = False
+    jitter: ColorJitter = dataclasses.field(default_factory=lambda: ColorJitter(
+        brightness=0.3, contrast=0.3, saturation=0.3, hue=0.3 / 3.14))
+    margin_y: int = 20
+    margin_x: int = 50
+
+    def color_transform(self, rng, img1, img2):  # symmetric only
+        stack = np.concatenate([img1, img2], axis=0)
+        stack = self.jitter(rng, stack)
+        return np.split(stack, 2, axis=0)
+
+    def spatial_transform(self, rng, img1, img2, flow, valid):
+        ht, wd = img1.shape[:2]
+        floor = max((self.crop_size[0] + 1) / float(ht),
+                    (self.crop_size[1] + 1) / float(wd))
+        scale = 2.0 ** rng.uniform(self.min_scale, self.max_scale)
+        sx = sy = max(scale, floor)
+
+        if rng.random() < self.spatial_aug_prob:
+            img1 = cv2.resize(img1, None, fx=sx, fy=sy,
+                              interpolation=cv2.INTER_LINEAR)
+            img2 = cv2.resize(img2, None, fx=sx, fy=sy,
+                              interpolation=cv2.INTER_LINEAR)
+            flow, valid = resize_sparse_flow_map(flow, valid, fx=sx, fy=sy)
+
+        if self.do_flip and rng.random() < 0.5:
+            img1 = img1[:, ::-1]
+            img2 = img2[:, ::-1]
+            flow = flow[:, ::-1] * [-1.0, 1.0]
+            valid = valid[:, ::-1]
+
+        y0 = int(rng.integers(0, img1.shape[0] - self.crop_size[0]
+                              + self.margin_y))
+        x0 = int(rng.integers(-self.margin_x, img1.shape[1]
+                              - self.crop_size[1] + self.margin_x))
+        y0 = int(np.clip(y0, 0, img1.shape[0] - self.crop_size[0]))
+        x0 = int(np.clip(x0, 0, img1.shape[1] - self.crop_size[1]))
+        sl = np.s_[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        return img1[sl], img2[sl], flow[sl], valid[sl]
+
+    def __call__(self, rng, img1, img2, flow, valid):  # type: ignore[override]
+        img1, img2 = self.color_transform(rng, img1, img2)
+        img1, img2 = self.eraser_transform(rng, img1, img2)
+        img1, img2, flow, valid = self.spatial_transform(
+            rng, img1, img2, flow, valid)
+        return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),
+                np.ascontiguousarray(flow.astype(np.float32)),
+                np.ascontiguousarray(valid))
