@@ -48,13 +48,15 @@ class RAFTConfig:
 
     @classmethod
     def full(cls, **kw) -> "RAFTConfig":
-        return cls(small=False, hidden_dim=128, context_dim=128,
-                   corr_levels=4, corr_radius=4, **kw)
+        base = dict(small=False, hidden_dim=128, context_dim=128,
+                    corr_levels=4, corr_radius=4)
+        return cls(**{**base, **kw})
 
     @classmethod
     def small_model(cls, **kw) -> "RAFTConfig":
-        return cls(small=True, hidden_dim=96, context_dim=64,
-                   corr_levels=4, corr_radius=3, **kw)
+        base = dict(small=True, hidden_dim=96, context_dim=64,
+                    corr_levels=4, corr_radius=3)
+        return cls(**{**base, **kw})
 
     @property
     def corr_planes(self) -> int:

@@ -197,7 +197,7 @@ class FlyingChairs(FlowDataset):
     """Train/val split via ``chairs_split.txt`` (reference
     datasets.py:121-134)."""
 
-    def __init__(self, aug_params=None, split="train",
+    def __init__(self, aug_params=None, split="training",
                  root="datasets/FlyingChairs_release/data",
                  split_file="chairs_split.txt"):
         super().__init__(aug_params)
@@ -205,7 +205,12 @@ class FlyingChairs(FlowDataset):
         flows = sorted(glob(osp.join(root, "*.flo")))
         assert len(images) // 2 == len(flows), (len(images), len(flows))
         split_ids = np.loadtxt(split_file, dtype=np.int32)
-        want = 1 if split == "training" else 2
+        if split in ("training", "train"):
+            want = 1
+        elif split in ("validation", "val"):
+            want = 2
+        else:
+            raise ValueError(f"unknown FlyingChairs split: {split!r}")
         for i in range(len(flows)):
             if split_ids[i] == want:
                 self.flow_list.append(flows[i])
@@ -364,19 +369,32 @@ class ShardedLoader:
     def batches(self, start_epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         """Infinite batch stream, epoch after epoch (the reference wraps its
         loader in an outer while-loop, train.py:161-208)."""
+        from collections import deque
+
         epoch = start_epoch
+        # Bounded prefetch: at most ~2 batches of futures in flight, so the
+        # workers can't race ahead of the consumer and buffer an entire
+        # epoch of decoded samples in host RAM.
+        window = max(2 * self.batch_size, 2 * self.num_workers)
         with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
             while True:
                 idx = self.epoch_indices(epoch)
                 n = len(idx)
                 usable = (n // self.batch_size) * self.batch_size \
                     if self.drop_last else n
-                samples = pool.map(
-                    lambda i: self._load_one(epoch, i), idx[:usable],
-                    chunksize=1)
+                pending = deque()
+                it = iter(idx[:usable])
+                for i in it:
+                    pending.append(pool.submit(self._load_one, epoch, i))
+                    if len(pending) >= window:
+                        break
                 buf: List[Dict[str, np.ndarray]] = []
-                for s in samples:
-                    buf.append(s)
+                while pending:
+                    buf.append(pending.popleft().result())
+                    nxt = next(it, None)
+                    if nxt is not None:
+                        pending.append(
+                            pool.submit(self._load_one, epoch, nxt))
                     if len(buf) == self.batch_size:
                         yield {k: np.stack([b[k] for b in buf])
                                for k in buf[0]}

@@ -1,0 +1,8 @@
+"""SPMD parallelism: device mesh construction and sharding helpers."""
+
+from raft_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    batch_sharding,
+    replicated_sharding,
+    shard_batch,
+)

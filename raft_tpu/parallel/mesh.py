@@ -1,0 +1,64 @@
+"""Device mesh + sharding helpers (the XLA-collective replacement for the
+reference's ``nn.DataParallel``, train.py:138 / SURVEY.md C16).
+
+Design: a 2-D ``(data, spatial)`` mesh.  Data parallelism shards the batch
+over ``data`` (gradient psum rides ICI, inserted by XLA from the sharding
+annotations — no hand-written collectives).  The ``spatial`` axis is
+reserved for sharding the correlation volume / feature maps over image
+height for very large inputs (the long-context analog; SURVEY.md §5);
+size 1 until explicitly requested.
+
+Multi-host: each process constructs the same global mesh from
+``jax.devices()`` and feeds only its addressable shard of the batch
+(``raft_tpu.data.ShardedLoader`` handles the per-host slicing) —
+DCN-vs-ICI placement is XLA's job, not ours.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SPATIAL_AXIS = "spatial"
+
+
+def make_mesh(num_data: Optional[int] = None, num_spatial: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the ``(data, spatial)`` mesh.  Defaults to all devices on the
+    data axis — RAFT at 5.3M params wants pure DP (SURVEY.md C16)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_data is None:
+        assert len(devices) % num_spatial == 0
+        num_data = len(devices) // num_spatial
+    n = num_data * num_spatial
+    assert n <= len(devices), (num_data, num_spatial, len(devices))
+    grid = np.asarray(devices[:n]).reshape(num_data, num_spatial)
+    return Mesh(grid, (DATA_AXIS, SPATIAL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading (batch) dim sharded over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh):
+    """Place a host batch onto the mesh, batch-dim sharded over ``data``.
+
+    Single-host: a plain sharded device_put.  Multi-host: each process
+    passes its *local* batch (its stride of the global shuffle from
+    ``ShardedLoader``) and the global array is assembled from the
+    process-local shards — the global batch is ``num_hosts * local_batch``.
+    """
+    sh = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sh, x), batch)

@@ -1,0 +1,6 @@
+"""L5 training subsystem: loss, optimizer, SPMD train step, loop."""
+
+from raft_tpu.train.loss import sequence_loss, flow_metrics  # noqa: F401
+from raft_tpu.train.optim import onecycle_lr, make_optimizer  # noqa: F401
+from raft_tpu.train.state import TrainState  # noqa: F401
+from raft_tpu.train.step import make_train_step, init_state  # noqa: F401

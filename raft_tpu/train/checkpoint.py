@@ -1,0 +1,63 @@
+"""Orbax checkpointing with auto-resume.
+
+Improves on the reference (SURVEY.md §5): ``torch.save(state_dict())``
+every 5000 steps kept weights only — optimizer/scheduler/step state was
+lost and the LR schedule restarted on resume (train.py:186-187,141-142).
+Here the FULL TrainState (params + batch_stats + optimizer state + step)
+is saved asynchronously, and ``restore_latest`` makes a preempted pod run
+continue exactly where it stopped.  Weights-only restore (for curriculum
+stage seeding, the reference's ``strict=False`` use case) is
+``restore_params``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from raft_tpu.train.state import TrainState
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax CheckpointManager for TrainState pytrees."""
+
+    def __init__(self, directory: str, max_to_keep: int = 5,
+                 async_save: bool = True):
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=async_save)
+        self._mgr = ocp.CheckpointManager(self._dir, options=opts)
+
+    def save(self, step: int, state: TrainState, force: bool = False) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, template: TrainState) -> Optional[TrainState]:
+        """Full-state restore for preemption recovery; None if no ckpt."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+
+    def restore_params(self, template: TrainState) -> Optional[Any]:
+        """Weights(+batch_stats)-only restore: seeds the next curriculum
+        stage without carrying optimizer state (reference strict=False
+        restore, train.py:141-142)."""
+        st = self.restore_latest(template)
+        if st is None:
+            return None
+        return {"params": st.params, "batch_stats": st.batch_stats}
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,66 @@
+"""Training metrics logger (reference ``Logger``, train.py:89-133).
+
+Running means printed every ``log_freq`` steps with step + current LR, and
+mirrored to TensorBoard when available.  Metric device->host transfers are
+batched per log interval, never per step — the step loop stays async.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+class Logger:
+    def __init__(self, log_freq: int = 100,
+                 lr_fn: Optional[Callable[[int], float]] = None,
+                 tensorboard_dir: Optional[str] = None):
+        self.log_freq = log_freq
+        self.lr_fn = lr_fn
+        self._pending: list = []  # device arrays; pulled once per interval
+        self._writer = None
+        self._tb_dir = tensorboard_dir
+
+    def _ensure_writer(self):
+        # Lazily created like the reference (train.py:105-106).
+        if self._writer is None and self._tb_dir is not None:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._writer = SummaryWriter(self._tb_dir)
+            except Exception:
+                self._tb_dir = None
+        return self._writer
+
+    def push(self, step: int, metrics: Dict) -> None:
+        # Keep the device arrays; converting here would block on the jitted
+        # step every iteration and kill the async dispatch pipeline.
+        self._pending.append(metrics)
+        if len(self._pending) >= self.log_freq:
+            count = len(self._pending)
+            sums: Dict[str, float] = {}
+            for m in self._pending:  # one sync per interval, not per step
+                for k, v in m.items():
+                    sums[k] = sums.get(k, 0.0) + float(np.asarray(v))
+            means = {k: s / count for k, s in sums.items()}
+            lr = self.lr_fn(step) if self.lr_fn else float("nan")
+            body = ", ".join(f"{k} {v:10.4f}" for k, v in sorted(means.items()))
+            print(f"[{step + 1:6d}, {lr:10.7f}] {body}", flush=True)
+            w = self._ensure_writer()
+            if w is not None:
+                for k, v in means.items():
+                    w.add_scalar(k, v, step + 1)
+            self._pending = []
+
+    def write_dict(self, step: int, results: Dict[str, float]) -> None:
+        """Validation results (reference write_dict, train.py:125-130)."""
+        print(" ".join(f"{k}={v:.4f}" for k, v in results.items()),
+              flush=True)
+        w = self._ensure_writer()
+        if w is not None:
+            for k, v in results.items():
+                w.add_scalar(k, v, step)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()

@@ -1,0 +1,117 @@
+"""The training driver (reference ``train(args)``, train.py:136-212).
+
+TPU-first shape of the loop:
+
+- one jitted SPMD step over the device mesh (no DataParallel wrapper);
+- host-side loader threads overlap decode/augment with device compute
+  (dispatch is async; the only sync point is the periodic metrics pull);
+- orbax checkpoints carry the full state; a preempted run auto-resumes
+  from the latest step (the reference restarts its schedule, SURVEY.md §5);
+- optional gaussian image noise parity (train.py:167-170).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from raft_tpu.config import RAFTConfig, TrainConfig
+from raft_tpu.models.raft import RAFT
+from raft_tpu.parallel import make_mesh, shard_batch
+from raft_tpu.train.checkpoint import CheckpointManager
+from raft_tpu.train.logger import Logger
+from raft_tpu.train.loss import sequence_loss  # noqa: F401 (re-export)
+from raft_tpu.train.optim import make_optimizer, schedule_of
+from raft_tpu.train.state import TrainState
+from raft_tpu.train.step import init_state, make_train_step
+
+
+def add_image_noise(rng: np.random.Generator, batch: Dict) -> Dict:
+    """Gaussian noise with stdv ~ U(0, 5), clipped to [0, 255]
+    (reference train.py:167-170)."""
+    out = dict(batch)
+    stdv = rng.uniform(0.0, 5.0)  # one draw, both frames (train.py:168)
+    for k in ("image1", "image2"):
+        out[k] = np.clip(
+            batch[k] + stdv * rng.standard_normal(batch[k].shape)
+                               .astype(np.float32), 0.0, 255.0)
+    return out
+
+
+def train(model_cfg: RAFTConfig, cfg: TrainConfig,
+          batches, *,
+          validators: Optional[Dict[str, Callable]] = None,
+          restore_params=None,
+          tensorboard_dir: Optional[str] = None,
+          mesh=None) -> TrainState:
+    """Run the full training loop.
+
+    ``batches``: iterator of host batches (dicts of NHWC numpy arrays) —
+    normally ``ShardedLoader(...).batches()``.
+    ``validators``: name -> fn(variables) -> dict, run every ``val_freq``
+    steps (reference train.py:190-196).
+    ``restore_params``: optional {'params', 'batch_stats'} to seed from a
+    previous curriculum stage (reference --restore_ckpt, train.py:141-142).
+    """
+    mesh = mesh or make_mesh()
+    model = RAFT(model_cfg)
+    tx = make_optimizer(cfg.lr, cfg.num_steps, cfg.wdecay, cfg.epsilon,
+                        cfg.clip)
+    state = init_state(model, tx, jax.random.PRNGKey(cfg.seed),
+                       cfg.image_size)
+    if restore_params is not None:
+        state = state.replace(
+            params=restore_params["params"],
+            batch_stats=restore_params.get("batch_stats", state.batch_stats))
+    print(f"Parameter Count: {state.param_count()}", flush=True)
+
+    ckpt_dir = os.path.join(cfg.ckpt_dir, cfg.name)
+    mgr = CheckpointManager(ckpt_dir)
+    resumed = mgr.restore_latest(state)
+    if resumed is not None:
+        state = resumed
+        print(f"resumed from step {int(state.step)}", flush=True)
+
+    step_fn = make_train_step(model, tx, cfg, mesh)
+    logger = Logger(cfg.log_freq, lr_fn=schedule_of(cfg.lr, cfg.num_steps),
+                    tensorboard_dir=tensorboard_dir)
+    noise_rng = np.random.default_rng(cfg.seed + 1)
+    key = jax.random.PRNGKey(cfg.seed)
+
+    step = int(state.step)
+    t0, steps_t0 = time.time(), step
+    for batch in batches:
+        if step >= cfg.num_steps:
+            break
+        if cfg.add_noise:
+            batch = add_image_noise(noise_rng, batch)
+        state, metrics = step_fn(state, shard_batch(batch, mesh), key)
+        step += 1
+        logger.push(step - 1, metrics)
+
+        if step % cfg.val_freq == 0:
+            mgr.save(step, state)
+            if validators:
+                variables = {"params": state.params}
+                if state.batch_stats:
+                    variables["batch_stats"] = state.batch_stats
+                results = {}
+                for name, fn in validators.items():
+                    results.update(fn(variables))
+                logger.write_dict(step, results)
+            dt = time.time() - t0
+            ips = (step - steps_t0) * cfg.batch_size / max(dt, 1e-9)
+            print(f"throughput: {ips:.2f} image-pairs/sec (host)",
+                  flush=True)
+            t0, steps_t0 = time.time(), step
+
+    if mgr.latest_step() != int(state.step):
+        mgr.save(int(state.step), state, force=True)
+    mgr.wait()
+    mgr.close()
+    logger.close()
+    return state

@@ -1,0 +1,65 @@
+"""Sequence loss and flow metrics (reference ``train.py:42-76``).
+
+The reference computes the loss over a Python list of per-iteration
+predictions (train.py:47-60); here predictions arrive as one stacked
+``(iters, B, H, W, 2)`` array (the `lax.scan` output) and the weighted sum
+is a single vectorized contraction — XLA fuses it into the backward pass.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+
+def flow_metrics(flow_pred: jnp.ndarray, flow_gt: jnp.ndarray,
+                 valid: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """End-point-error stats over valid pixels (reference train.py:62-70).
+
+    ``flow_pred``/``flow_gt``: (B, H, W, 2); ``valid``: (B, H, W) in {0,1}.
+    """
+    epe = jnp.sqrt(jnp.sum((flow_pred - flow_gt) ** 2, axis=-1))
+    mask = valid > 0.5
+    n = jnp.maximum(jnp.sum(mask), 1)
+
+    def vmean(x):
+        return jnp.sum(jnp.where(mask, x, 0.0)) / n
+
+    return {
+        "epe": vmean(epe),
+        "1px": vmean((epe < 1.0).astype(jnp.float32)),
+        "3px": vmean((epe < 3.0).astype(jnp.float32)),
+        "5px": vmean((epe < 5.0).astype(jnp.float32)),
+    }
+
+
+def sequence_loss(flow_preds: jnp.ndarray, flow_gt: jnp.ndarray,
+                  valid: jnp.ndarray, gamma: float = 0.8,
+                  max_flow: float = 400.0
+                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Exponentially-weighted L1 over the prediction sequence
+    (reference ``sequence_loss``, train.py:47-72).
+
+    - ``flow_preds``: (iters, B, H, W, 2) stacked per-iteration flows.
+    - weight of prediction i is ``gamma**(iters - i - 1)`` (train.py:55).
+    - pixels with ``|flow_gt| >= max_flow`` or invalid are excluded
+      (train.py:51-52); like the reference, the per-iteration term is the
+      mean over *all* pixels with invalid ones zeroed (train.py:58-59),
+      not the mean over valid pixels.
+    """
+    n_predictions = flow_preds.shape[0]
+    mag = jnp.sqrt(jnp.sum(flow_gt ** 2, axis=-1))
+    valid = (valid > 0.5) & (mag < max_flow)
+    vmask = valid[None, ..., None].astype(flow_preds.dtype)
+
+    i = jnp.arange(n_predictions, dtype=flow_preds.dtype)
+    weights = gamma ** (n_predictions - i - 1.0)
+
+    abs_err = jnp.abs(flow_preds - flow_gt[None])
+    per_iter = jnp.mean(vmask * abs_err, axis=(1, 2, 3, 4))
+    flow_loss = jnp.sum(weights * per_iter)
+
+    metrics = flow_metrics(flow_preds[-1], flow_gt,
+                           valid.astype(jnp.float32))
+    return flow_loss, metrics

@@ -1,0 +1,52 @@
+"""Optimizer and LR schedule (reference ``fetch_optimizer``, train.py:79-86).
+
+The reference: AdamW(lr, wdecay, eps) + ``OneCycleLR(lr, num_steps + 100,
+pct_start=0.05, cycle_momentum=False, anneal_strategy='linear')`` and a
+global-norm gradient clip of 1.0 applied manually each step (train.py:177).
+Here the clip is part of the optax chain, and there is no GradScaler: bf16
+on TPU keeps the fp32 exponent range, so loss scaling is unnecessary
+(SURVEY.md north star).
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def onecycle_lr(peak_lr: float, total_steps: int, pct_start: float = 0.05,
+                div_factor: float = 25.0, final_div_factor: float = 1e4):
+    """torch OneCycleLR with ``anneal_strategy='linear'`` parity: warm up
+    from ``peak/div_factor`` over ``pct_start`` of the run, then anneal
+    linearly to ``peak/(div_factor*final_div_factor)``.
+
+    The reference passes ``total_steps = num_steps + 100`` (train.py:83) so
+    training stops 100 steps short of the annealing floor — callers should
+    do the same for parity.
+    """
+    initial = peak_lr / div_factor
+    final = initial / final_div_factor
+    # torch's phase boundaries: warmup ends at step pct_start*total - 1 and
+    # the anneal reaches `final` at step total - 1.
+    warm_end = max(int(round(pct_start * total_steps)) - 1, 1)
+    return optax.join_schedules(
+        [optax.linear_schedule(initial, peak_lr, warm_end),
+         optax.linear_schedule(peak_lr, final, total_steps - 1 - warm_end)],
+        boundaries=[warm_end])
+
+
+def make_optimizer(lr: float, num_steps: int, wdecay: float = 1e-4,
+                   epsilon: float = 1e-8, clip: float = 1.0,
+                   pct_start: float = 0.05) -> optax.GradientTransformation:
+    """AdamW + OneCycle + global-norm clip (reference train.py:79-86,177)."""
+    schedule = onecycle_lr(lr, num_steps + 100, pct_start)
+    return optax.chain(
+        optax.clip_by_global_norm(clip),
+        optax.adamw(schedule, b1=0.9, b2=0.999, eps=epsilon,
+                    weight_decay=wdecay),
+    )
+
+
+def schedule_of(lr: float, num_steps: int, pct_start: float = 0.05):
+    """The schedule alone (for logging the current LR, reference
+    train.py:110 logs ``scheduler.get_last_lr()``)."""
+    return onecycle_lr(lr, num_steps + 100, pct_start)

@@ -1,0 +1,40 @@
+"""Train state: one flat pytree holding everything a step mutates.
+
+Replaces the reference's scattered mutable objects (model, optimizer,
+scheduler, GradScaler — train.py:151-154) and the ``module.``-prefixed
+DataParallel checkpoints (SURVEY.md §3.5): the state is a plain pytree, so
+checkpointing it (orbax) and sharding it (pjit) are trivial.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.struct
+import jax
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    batch_stats: Any          # flax BatchNorm running stats ({} if none)
+    opt_state: optax.OptState
+
+    def apply_gradients(self, grads, tx: optax.GradientTransformation,
+                        new_batch_stats=None) -> "TrainState":
+        updates, new_opt = tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            batch_stats=(self.batch_stats if new_batch_stats is None
+                         else new_batch_stats),
+            opt_state=new_opt,
+        )
+
+    def param_count(self) -> int:
+        """Total parameter count (the reference prints it at startup,
+        train.py:139)."""
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(self.params))

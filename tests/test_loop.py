@@ -1,0 +1,76 @@
+"""End-to-end loop test: synthetic in-memory batches, checkpoint/resume."""
+
+import numpy as np
+import jax
+import pytest
+
+from raft_tpu.config import RAFTConfig, TrainConfig
+from raft_tpu.models.raft import RAFT
+from raft_tpu.train import init_state, make_optimizer
+from raft_tpu.train.checkpoint import CheckpointManager
+from raft_tpu.train.loop import add_image_noise, train
+
+
+def _batches(n, tcfg, seed=0):
+    rng = np.random.default_rng(seed)
+    H, W = tcfg.image_size
+    for _ in range(n):
+        img1 = rng.uniform(0, 255, size=(tcfg.batch_size, H, W, 3)
+                           ).astype(np.float32)
+        img2 = np.roll(img1, 1, axis=2)
+        flow = np.zeros((tcfg.batch_size, H, W, 2), np.float32)
+        flow[..., 0] = 1.0
+        yield {"image1": img1, "image2": img2, "flow": flow,
+               "valid": np.ones((tcfg.batch_size, H, W), np.float32)}
+
+
+def test_add_image_noise_bounds():
+    tcfg = TrainConfig(batch_size=2, image_size=(16, 16))
+    b = next(_batches(1, tcfg))
+    out = add_image_noise(np.random.default_rng(0), b)
+    assert out["image1"].min() >= 0 and out["image1"].max() <= 255
+    assert not np.array_equal(out["image1"], b["image1"])
+    np.testing.assert_array_equal(out["flow"], b["flow"])
+
+
+def test_train_loop_checkpoint_and_resume(tmp_path):
+    mcfg = RAFTConfig.small_model(corr_levels=2, corr_radius=2)
+    tcfg = TrainConfig(name="t", lr=1e-4, num_steps=4, batch_size=8,
+                       image_size=(32, 32), iters=2, val_freq=2,
+                       log_freq=2, ckpt_dir=str(tmp_path))
+    calls = []
+
+    def fake_validator(variables):
+        calls.append(1)
+        return {"val/metric": 1.0}
+
+    state = train(mcfg, tcfg, _batches(10, tcfg),
+                  validators={"fake": fake_validator})
+    assert int(state.step) == 4
+    assert len(calls) == 2  # steps 2 and 4
+
+    # Resume: a fresh call with the same ckpt_dir restores step 4 and
+    # trains on to step 6.
+    import dataclasses
+    state2 = train(mcfg, dataclasses.replace(tcfg, num_steps=6),
+                   _batches(10, tcfg))
+    assert int(state2.step) == 6
+
+
+def test_checkpoint_manager_roundtrip(tmp_path):
+    mcfg = RAFTConfig.small_model(corr_levels=2, corr_radius=2)
+    model = RAFT(mcfg)
+    tx = make_optimizer(1e-4, 10)
+    state = init_state(model, tx, jax.random.PRNGKey(0), (32, 32))
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=False)
+    mgr.save(3, state, force=True)
+    mgr.wait()
+    assert mgr.latest_step() == 3
+    restored = mgr.restore_latest(state)
+    leaves0 = jax.tree_util.tree_leaves(state.params)
+    leaves1 = jax.tree_util.tree_leaves(restored.params)
+    for a, b in zip(leaves0, leaves1):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    p = mgr.restore_params(state)
+    assert "params" in p and "batch_stats" in p
+    mgr.close()
