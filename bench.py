@@ -1,0 +1,78 @@
+"""Benchmark: RAFT training throughput, image-pairs/sec/chip.
+
+Runs the full jitted SPMD training step (forward + backward + AdamW update,
+bf16 compute, 12 refinement iterations) on synthetic FlyingChairs-shaped
+batches (reference train_standard.sh chairs stage: 368x496 crops) and
+prints ONE JSON line.  Baseline: 30 image-pairs/sec/chip
+(BASELINE.json north_star, v5e).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+from raft_tpu.config import RAFTConfig, TrainConfig
+from raft_tpu.models.raft import RAFT
+from raft_tpu.parallel.mesh import make_mesh, shard_batch
+from raft_tpu.train.optim import make_optimizer
+from raft_tpu.train.step import init_state, make_train_step
+
+BASELINE_PAIRS_PER_SEC_PER_CHIP = 30.0
+
+
+def main():
+    n_dev = jax.device_count()
+    mesh = make_mesh(num_data=n_dev, num_spatial=1)
+
+    H, W = 368, 496           # chairs crop, train_standard.sh:3
+    per_chip_batch = 6
+    B = per_chip_batch * n_dev
+    model_cfg = RAFTConfig.full(compute_dtype="bfloat16")
+    cfg = TrainConfig(num_steps=1000, batch_size=B, image_size=(H, W),
+                      iters=12)
+
+    model = RAFT(model_cfg)
+    tx = make_optimizer(cfg.lr, cfg.num_steps, cfg.wdecay, cfg.epsilon,
+                        cfg.clip)
+    state = init_state(model, tx, jax.random.PRNGKey(0), (H, W))
+    step_fn = make_train_step(model, tx, cfg, mesh)
+
+    rng = np.random.default_rng(0)
+    batch = shard_batch({
+        "image1": rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32),
+        "image2": rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32),
+        "flow": (8.0 * rng.standard_normal((B, H, W, 2))).astype(np.float32),
+        "valid": np.ones((B, H, W), np.float32),
+    }, mesh)
+    key = jax.random.PRNGKey(1)
+
+    # Warmup (compile) + 2 steady-state steps.  float() forces a real
+    # device sync (block_until_ready alone has proven unreliable on the
+    # tunneled platform).
+    for _ in range(3):
+        state, metrics = step_fn(state, batch, key)
+    float(metrics["loss"])
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step_fn(state, batch, key)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    pairs_per_sec_per_chip = n_steps * B / dt / n_dev
+    print(json.dumps({
+        "metric": "train_throughput_flyingchairs_368x496_bf16_iters12",
+        "value": round(pairs_per_sec_per_chip, 3),
+        "unit": "image-pairs/sec/chip",
+        "vs_baseline": round(
+            pairs_per_sec_per_chip / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

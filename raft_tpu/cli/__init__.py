@@ -1,0 +1,10 @@
+"""Command-line entry points (reference L6: train.py / evaluate.py /
+demo.py / a_lk_vs_raft.py argparse scripts, SURVEY.md §1).
+
+Run as modules::
+
+    python -m raft_tpu.cli.train --name raft-chairs --stage chairs ...
+    python -m raft_tpu.cli.evaluate --model checkpoints/raft-things ...
+    python -m raft_tpu.cli.demo --model checkpoints/raft-things --path frames/
+    python -m raft_tpu.cli.lk_compare --model checkpoints/raft-things ...
+"""

@@ -1,0 +1,85 @@
+"""Evaluation CLI (reference ``evaluate.py:169-195`` flags).
+
+``--model`` is an orbax checkpoint directory: either a bare variables tree
+(``save_variables`` / the torch converter) or a training run's
+``ckpt_dir/name`` (weights are extracted from the latest step).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="RAFT-TPU evaluation")
+    p.add_argument("--model", required=True, help="checkpoint directory")
+    p.add_argument("--dataset", required=True,
+                   choices=["chairs", "sintel", "kitti"])
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--precision", default="bf16", choices=["bf16", "fp32"])
+    p.add_argument("--alternate_corr", action="store_true",
+                   help="memory-efficient on-demand correlation "
+                        "(reference --alternate_corr)")
+    p.add_argument("--iters", type=int, default=None,
+                   help="refinement iterations (default: reference "
+                        "per-dataset values: 24/32/24)")
+    p.add_argument("--data_root", default="datasets")
+    p.add_argument("--chairs_split", default="chairs_split.txt")
+    return p.parse_args(argv)
+
+
+def load_model_variables(path: str):
+    """Variables from a bare-pytree checkpoint dir (``save_variables`` /
+    the torch converter), or from the latest step of a training-run
+    checkpoint directory (orbax CheckpointManager layout:
+    ``<dir>/<step>/default``)."""
+    import os
+
+    from raft_tpu.train import checkpoint as ckpt
+
+    if os.path.exists(os.path.join(path, "_METADATA")):
+        return ckpt.load_variables(path)
+    steps = sorted(int(d) for d in os.listdir(path) if d.isdigit())
+    assert steps, f"no checkpoint found under {path}"
+    tree = ckpt.load_variables(os.path.join(path, str(steps[-1]),
+                                            "default"))
+    if "opt_state" in tree or "step" in tree:  # full TrainState pytree
+        tree = {"params": tree["params"],
+                "batch_stats": tree.get("batch_stats", {})}
+    return tree
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    import os.path as osp
+
+    from raft_tpu import evaluate
+    from raft_tpu.config import RAFTConfig
+
+    compute_dtype = "bfloat16" if args.precision == "bf16" else "float32"
+    mk = RAFTConfig.small_model if args.small else RAFTConfig.full
+    model_cfg = mk(compute_dtype=compute_dtype,
+                   corr_impl="chunked" if args.alternate_corr
+                   else "allpairs")
+    variables = load_model_variables(args.model)
+    if "batch_stats" not in variables:
+        variables = dict(variables, batch_stats={})
+
+    default_iters = {"chairs": 24, "sintel": 32, "kitti": 24}
+    iters = args.iters or default_iters[args.dataset]
+    if args.dataset == "chairs":
+        evaluate.validate_chairs(
+            variables, model_cfg, iters=iters,
+            root=osp.join(args.data_root, "FlyingChairs_release/data"),
+            split_file=args.chairs_split)
+    elif args.dataset == "sintel":
+        evaluate.validate_sintel(variables, model_cfg, iters=iters,
+                                 root=osp.join(args.data_root, "Sintel"))
+    else:
+        evaluate.validate_kitti(variables, model_cfg, iters=iters,
+                                root=osp.join(args.data_root, "KITTI"))
+
+
+if __name__ == "__main__":
+    main()

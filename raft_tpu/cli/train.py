@@ -1,0 +1,134 @@
+"""Training CLI (reference ``train.py:217-246`` flags).
+
+Differences from the reference, by design:
+
+- ``--gpus`` is gone: the job uses every device in the mesh
+  (``jax.devices()``); ``--batch_size`` stays GLOBAL and is sharded over
+  the ``data`` axis.
+- ``--mixed_precision`` maps to bf16 compute (default ON — it is the right
+  choice on TPU; pass ``--precision fp32`` to disable).  There is no
+  GradScaler: bf16 keeps fp32 exponent range.
+- ``--restore_ckpt`` takes an orbax checkpoint directory (a previous
+  stage's ``ckpt_dir/name``) and seeds weights only, like the reference's
+  ``strict=False`` load (train.py:141-142).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os.path as osp
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="RAFT-TPU training")
+    p.add_argument("--name", default="raft", help="experiment name")
+    p.add_argument("--stage", default="chairs",
+                   choices=["chairs", "things", "sintel", "kitti"])
+    p.add_argument("--restore_ckpt", default=None,
+                   help="orbax ckpt dir of a previous stage")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--validation", nargs="+", default=[],
+                   choices=["chairs", "sintel", "kitti"])
+    p.add_argument("--lr", type=float, default=4e-4)
+    p.add_argument("--num_steps", type=int, default=100000)
+    p.add_argument("--batch_size", type=int, default=6,
+                   help="GLOBAL batch size (sharded over devices)")
+    p.add_argument("--image_size", type=int, nargs=2, default=[384, 512])
+    p.add_argument("--precision", default="bf16", choices=["bf16", "fp32"])
+    p.add_argument("--iters", type=int, default=12)
+    p.add_argument("--wdecay", type=float, default=1e-4)
+    p.add_argument("--epsilon", type=float, default=1e-8)
+    p.add_argument("--clip", type=float, default=1.0)
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--gamma", type=float, default=0.8,
+                   help="exponential loss weighting")
+    p.add_argument("--add_noise", action="store_true")
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--corr_impl", default="allpairs",
+                   choices=["allpairs", "chunked", "pallas"])
+    p.add_argument("--data_root", default="datasets")
+    p.add_argument("--chairs_split", default="chairs_split.txt")
+    p.add_argument("--ckpt_dir", default="checkpoints")
+    p.add_argument("--tensorboard_dir", default=None)
+    p.add_argument("--num_workers", type=int, default=4)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    import jax
+
+    from raft_tpu import evaluate
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.data.datasets import ShardedLoader, fetch_dataset
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.train.checkpoint import CheckpointManager
+    from raft_tpu.train.loop import train
+    from raft_tpu.train.optim import make_optimizer
+    from raft_tpu.train.step import init_state
+
+    compute_dtype = "bfloat16" if args.precision == "bf16" else "float32"
+    mk = RAFTConfig.small_model if args.small else RAFTConfig.full
+    model_cfg = mk(dropout=args.dropout, corr_impl=args.corr_impl,
+                   compute_dtype=compute_dtype)
+    cfg = TrainConfig(
+        name=args.name, stage=args.stage, restore_ckpt=args.restore_ckpt,
+        validation=tuple(args.validation), lr=args.lr,
+        num_steps=args.num_steps, batch_size=args.batch_size,
+        image_size=tuple(args.image_size), iters=args.iters,
+        wdecay=args.wdecay, epsilon=args.epsilon, clip=args.clip,
+        gamma=args.gamma, add_noise=args.add_noise, seed=args.seed,
+        freeze_bn=args.stage != "chairs",  # reference train.py:147-148
+        ckpt_dir=args.ckpt_dir)
+
+    num_hosts = jax.process_count()
+    num_devices = jax.device_count()
+    assert args.batch_size % num_devices == 0, (
+        f"global --batch_size {args.batch_size} must divide evenly over "
+        f"the {num_devices}-device data mesh axis")
+    dataset = fetch_dataset(args.stage, tuple(args.image_size),
+                            root=args.data_root,
+                            split_file=args.chairs_split)
+    loader = ShardedLoader(dataset, args.batch_size // num_hosts,
+                           seed=args.seed, num_hosts=num_hosts,
+                           host_id=jax.process_index(),
+                           num_workers=args.num_workers)
+
+    restore = None
+    if args.restore_ckpt:
+        model = RAFT(model_cfg)
+        tx = make_optimizer(cfg.lr, cfg.num_steps, cfg.wdecay, cfg.epsilon,
+                            cfg.clip)
+        template = init_state(model, tx, jax.random.PRNGKey(0), (48, 64))
+        restore = CheckpointManager(args.restore_ckpt).restore_params(
+            template)
+        assert restore is not None, f"no checkpoint in {args.restore_ckpt}"
+        print(f"restored weights from {args.restore_ckpt}", flush=True)
+
+    roots = {
+        "chairs": dict(root=osp.join(args.data_root,
+                                     "FlyingChairs_release/data"),
+                       split_file=args.chairs_split),
+        "sintel": dict(root=osp.join(args.data_root, "Sintel")),
+        "kitti": dict(root=osp.join(args.data_root, "KITTI")),
+    }
+    # Bind one jitted eval forward per validator so periodic validation
+    # reuses the compilation across rounds (shapes are constant per split).
+    val_iters = {"chairs": 24, "sintel": 32, "kitti": 24}
+    validators = {
+        name: functools.partial(
+            evaluate.VALIDATORS[name], model_cfg=model_cfg,
+            iters=val_iters[name],
+            eval_fn=evaluate.make_eval_fn(model_cfg, val_iters[name]),
+            **roots[name])
+        for name in args.validation
+    }
+
+    train(model_cfg, cfg, loader=loader, validators=validators or None,
+          restore_params=restore, tensorboard_dir=args.tensorboard_dir)
+
+
+if __name__ == "__main__":
+    main()

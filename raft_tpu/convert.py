@@ -1,0 +1,208 @@
+"""Torch-checkpoint -> flax-pytree weight conversion.
+
+The reference ships ``.pth`` model-zoo checkpoints saved from an
+``nn.DataParallel`` wrapper (``module.``-prefixed keys, train.py:187,212).
+This maps them onto :class:`raft_tpu.models.raft.RAFT` variables:
+
+- ``module.`` prefix stripped (SURVEY.md §3.5);
+- conv weights OIHW -> HWIO;
+- ``fnet/cnet`` residual stages ``layerX.Y.`` -> ``layerX_Y``; the
+  downsample Sequential's conv (``downsample.0``) -> ``downsample_conv``,
+  and its norm alias (``downsample.1``, the same tensor the reference also
+  registers as ``norm3``/``norm4``, extractor.py:41-46) is dropped;
+- ``update_block.`` -> the scan-carried ``refine/update_block``;
+- the mask-head Sequential ``mask.0``/``mask.2`` (update.py:122-125)
+  -> ``mask_conv1``/``mask_conv2``;
+- norm ``weight/bias`` -> ``scale/bias`` under the auto-named
+  ``BatchNorm_0``/``GroupNorm_0`` submodule, ``running_mean/var`` -> the
+  ``batch_stats`` collection; ``num_batches_tracked`` is dropped.
+
+Conversion is validated structurally: every template leaf must be written
+exactly once with a matching shape, and every torch tensor consumed.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from raft_tpu.config import RAFTConfig
+
+
+def _flatten(tree, prefix=()) -> Dict[Tuple[str, ...], Any]:
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict) or hasattr(v, "items"):
+            out.update(_flatten(v, prefix + (k,)))
+        else:
+            out[prefix + (k,)] = v
+    return out
+
+
+def _unflatten(flat: Dict[Tuple[str, ...], Any]):
+    tree: Dict[str, Any] = {}
+    for path, v in flat.items():
+        node = tree
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = v
+    return tree
+
+
+def _torch_key_to_path(key: str):
+    """Reference state-dict key -> (collection, flax path tuple) or None to
+    skip (aliases / counters)."""
+    key = re.sub(r"^module\.", "", key)
+    parts = key.split(".")
+
+    if parts[-1] == "num_batches_tracked":
+        return None
+    if "downsample" in parts:
+        # downsample.0 = conv; downsample.1 aliases norm3/norm4 (which have
+        # their own keys).
+        i = parts.index("downsample")
+        if parts[i + 1] == "1":
+            return None
+        parts = parts[:i] + ["downsample_conv"] + parts[i + 2:]
+
+    # layerX.Y -> layerX_Y
+    merged = []
+    for p in parts:
+        if merged and re.fullmatch(r"layer\d+", merged[-1]) \
+                and re.fullmatch(r"\d+", p):
+            merged[-1] = f"{merged[-1]}_{p}"
+        else:
+            merged.append(p)
+    parts = merged
+
+    # mask Sequential -> mask_conv1/mask_conv2
+    if "mask" in parts:
+        i = parts.index("mask")
+        conv = {"0": "mask_conv1", "2": "mask_conv2"}[parts[i + 1]]
+        parts = parts[:i] + [conv] + parts[i + 2:]
+
+    if parts[0] == "update_block":
+        parts = ["refine"] + parts
+
+    leaf = parts[-1]
+    if leaf in ("running_mean", "running_var"):
+        stat = "mean" if leaf == "running_mean" else "var"
+        return "batch_stats", tuple(parts[:-1]) + ("<norm>", stat)
+    if leaf == "weight":
+        return "params", tuple(parts[:-1]) + ("<weight>",)
+    if leaf == "bias":
+        return "params", tuple(parts[:-1]) + ("<bias>",)
+    raise ValueError(f"unrecognized torch key: {key}")
+
+
+def convert_state_dict(state_dict: Dict[str, Any],
+                       template: Dict[str, Any]) -> Dict[str, Any]:
+    """Map a reference torch ``state_dict`` (tensors or ndarrays) onto the
+    flax ``template`` variables ({'params': ..., 'batch_stats': ...})."""
+    flat_tmpl = {("params",) + p: v
+                 for p, v in _flatten(template["params"]).items()}
+    flat_tmpl.update(
+        {("batch_stats",) + p: v
+         for p, v in _flatten(template.get("batch_stats", {})).items()})
+
+    out: Dict[Tuple[str, ...], np.ndarray] = {}
+    for key, tensor in state_dict.items():
+        mapped = _torch_key_to_path(key)
+        if mapped is None:
+            continue
+        coll, path = mapped
+        arr = np.asarray(getattr(tensor, "numpy", lambda: tensor)())
+
+        # Resolve the placeholder leaf against the template: norm
+        # weight/bias live under an auto-named BatchNorm_0/GroupNorm_0
+        # submodule; conv weight/bias live directly under the conv module.
+        prefix = (coll,) + path[:-1]
+        leaf = path[-1]
+        if leaf == "<weight>":
+            candidates = [prefix + ("kernel",),
+                          prefix + ("BatchNorm_0", "scale"),
+                          prefix + ("GroupNorm_0", "scale")]
+        elif leaf == "<bias>":
+            candidates = [prefix + ("bias",),
+                          prefix + ("BatchNorm_0", "bias"),
+                          prefix + ("GroupNorm_0", "bias")]
+        else:  # mean / var (path = (..., '<norm>', stat))
+            base = (coll,) + path[:-2]
+            candidates = [base + ("BatchNorm_0", leaf)]
+        full = next((c for c in candidates if c in flat_tmpl), None)
+        if full is None:
+            raise KeyError(
+                f"torch key {key!r} -> no template leaf among {candidates}")
+
+        if full[-1] == "kernel" and arr.ndim == 4:
+            arr = arr.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        want = flat_tmpl[full].shape
+        if tuple(arr.shape) != tuple(want):
+            raise ValueError(
+                f"shape mismatch for {key}: torch {arr.shape} vs "
+                f"flax {want} at {'/'.join(full)}")
+        if full in out:
+            raise ValueError(f"duplicate write to {'/'.join(full)}")
+        out[full] = arr.astype(np.asarray(flat_tmpl[full]).dtype)
+
+    missing = sorted(set(flat_tmpl) - set(out))
+    if missing:
+        raise ValueError(
+            "unfilled template leaves: "
+            + ", ".join("/".join(m) for m in missing[:10]))
+
+    tree = _unflatten(out)
+    result = {"params": tree["params"]}
+    if "batch_stats" in tree:
+        result["batch_stats"] = tree["batch_stats"]
+    elif "batch_stats" in template:
+        result["batch_stats"] = template["batch_stats"]
+    return result
+
+
+def make_template(model_cfg: RAFTConfig):
+    """Init-shape variables tree for the converter to fill."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.models.raft import RAFT
+
+    model = RAFT(model_cfg)
+    img = jnp.zeros((1, 48, 64, 3))
+    variables = model.init({"params": jax.random.PRNGKey(0),
+                            "dropout": jax.random.PRNGKey(0)},
+                           img, img, iters=1)
+    return {"params": variables["params"],
+            "batch_stats": dict(variables.get("batch_stats", {}))}
+
+
+def convert_checkpoint(pth_path: str, small: bool = False):
+    """Load a reference ``.pth`` and return converted flax variables."""
+    import torch
+
+    sd = torch.load(pth_path, map_location="cpu")
+    cfg = RAFTConfig.small_model() if small else RAFTConfig.full()
+    return convert_state_dict(sd, make_template(cfg))
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Convert a reference RAFT .pth to an orbax checkpoint")
+    p.add_argument("pth", help="path to torch checkpoint")
+    p.add_argument("out", help="output orbax checkpoint directory")
+    p.add_argument("--small", action="store_true")
+    args = p.parse_args(argv)
+
+    from raft_tpu.train.checkpoint import save_variables
+
+    variables = convert_checkpoint(args.pth, small=args.small)
+    save_variables(args.out, variables)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

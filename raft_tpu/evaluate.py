@@ -1,0 +1,203 @@
+"""Validation + leaderboard submission (reference ``evaluate.py``).
+
+Parity surface (SURVEY.md C13):
+
+- ``validate_chairs``  — EPE @ 24 iters (reference evaluate.py:75-93)
+- ``validate_sintel``  — clean+final EPE/1px/3px/5px @ 32 iters with
+  InputPadder (evaluate.py:96-128)
+- ``validate_kitti``   — EPE + F1-all (``epe>3 ∧ epe/mag>0.05``) @ 24 iters
+  (evaluate.py:131-166)
+- ``create_sintel_submission`` — optional warm start: previous frame's
+  1/8-res flow forward-interpolated into the next frame's ``flow_init``
+  (evaluate.py:22-51)
+- ``create_kitti_submission``  — 16-bit PNG flow writer (evaluate.py:54-72)
+
+TPU shape of the loop: one jitted test-mode forward per padded image shape
+(Sintel/KITTI resolutions are constant per split, so each validator
+compiles once and streams images through it); metrics accumulate on host in
+NumPy.  The reference's per-image ``np.mean(epe_list)`` ragged-array quirk
+(evaluate.py:118-125) is resolved in favor of the printed per-pixel mean.
+"""
+
+from __future__ import annotations
+
+import os
+import os.path as osp
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.data import datasets, frame_utils
+from raft_tpu.models.raft import RAFT
+from raft_tpu.ops.pad import InputPadder
+from raft_tpu.utils.warp import forward_interpolate
+
+
+def make_eval_fn(model_cfg: RAFTConfig, iters: int):
+    """Jitted ``(variables, image1, image2, flow_init) -> (flow_low,
+    flow_up)`` test-mode forward.  ``flow_init`` may be None (traced as a
+    static branch via two separate jit entries)."""
+    model = RAFT(model_cfg)
+
+    @jax.jit
+    def fwd(variables, image1, image2):
+        return model.apply(variables, image1, image2, iters=iters,
+                           test_mode=True, train=False)
+
+    @jax.jit
+    def fwd_init(variables, image1, image2, flow_init):
+        return model.apply(variables, image1, image2, iters=iters,
+                           flow_init=flow_init, test_mode=True, train=False)
+
+    def eval_fn(variables, image1, image2, flow_init=None):
+        if flow_init is None:
+            return fwd(variables, image1, image2)
+        return fwd_init(variables, image1, image2, flow_init)
+
+    return eval_fn
+
+
+def _prep(sample: Dict[str, np.ndarray], mode: str):
+    """Host sample -> padded (1,H,W,3) device arrays + padder."""
+    image1 = jnp.asarray(sample["image1"])[None]
+    image2 = jnp.asarray(sample["image2"])[None]
+    padder = InputPadder(image1.shape, mode=mode)
+    image1, image2 = padder.pad(image1, image2)
+    return image1, image2, padder
+
+
+def validate_chairs(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
+                    iters: int = 24,
+                    root: str = "datasets/FlyingChairs_release/data",
+                    split_file: str = "chairs_split.txt",
+                    eval_fn=None) -> Dict[str, float]:
+    """FlyingChairs validation-split EPE (reference evaluate.py:75-93)."""
+    eval_fn = eval_fn or make_eval_fn(model_cfg, iters)
+    ds = datasets.FlyingChairs(split="validation", root=root,
+                               split_file=split_file)
+    epe_list = []
+    for i in range(len(ds)):
+        sample = ds.load(i)
+        image1 = jnp.asarray(sample["image1"])[None]
+        image2 = jnp.asarray(sample["image2"])[None]
+        _, flow_up = eval_fn(variables, image1, image2)
+        epe = np.sqrt(np.sum(
+            (np.asarray(flow_up[0]) - sample["flow"]) ** 2, axis=-1))
+        epe_list.append(epe.reshape(-1))
+    epe = float(np.mean(np.concatenate(epe_list)))
+    print(f"Validation Chairs EPE: {epe:.3f}", flush=True)
+    return {"chairs": epe}
+
+
+def validate_sintel(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
+                    iters: int = 32, root: str = "datasets/Sintel",
+                    eval_fn=None) -> Dict[str, float]:
+    """Sintel training-split clean+final EPE (reference evaluate.py:96-128)."""
+    eval_fn = eval_fn or make_eval_fn(model_cfg, iters)
+    results = {}
+    for dstype in ("clean", "final"):
+        ds = datasets.MpiSintel(split="training", dstype=dstype, root=root)
+        epe_list = []
+        for i in range(len(ds)):
+            sample = ds.load(i)
+            image1, image2, padder = _prep(sample, "sintel")
+            _, flow_up = eval_fn(variables, image1, image2)
+            flow = np.asarray(padder.unpad(flow_up)[0])
+            epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
+            epe_list.append(epe.reshape(-1))
+        epe_all = np.concatenate(epe_list)
+        epe = float(np.mean(epe_all))
+        px1 = float(np.mean(epe_all < 1))
+        px3 = float(np.mean(epe_all < 3))
+        px5 = float(np.mean(epe_all < 5))
+        print(f"Validation ({dstype}) EPE: {epe:.3f}, 1px: {px1:.3f}, "
+              f"3px: {px3:.3f}, 5px: {px5:.3f}", flush=True)
+        results[dstype] = epe
+    return results
+
+
+def validate_kitti(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
+                   iters: int = 24, root: str = "datasets/KITTI",
+                   eval_fn=None) -> Dict[str, float]:
+    """KITTI-15 training-split EPE + F1-all (reference evaluate.py:131-166)."""
+    eval_fn = eval_fn or make_eval_fn(model_cfg, iters)
+    ds = datasets.KITTI(split="training", root=root)
+    epe_list, out_list = [], []
+    for i in range(len(ds)):
+        sample = ds.load(i)
+        image1, image2, padder = _prep(sample, "kitti")
+        _, flow_up = eval_fn(variables, image1, image2)
+        flow = np.asarray(padder.unpad(flow_up)[0])
+        epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
+        mag = np.sqrt(np.sum(sample["flow"] ** 2, axis=-1))
+        val = sample["valid"] >= 0.5
+        out = (epe > 3.0) & ((epe / np.maximum(mag, 1e-12)) > 0.05)
+        epe_list.append(epe[val].mean())
+        out_list.append(out[val])
+    epe = float(np.mean(epe_list))
+    f1 = 100.0 * float(np.mean(np.concatenate(out_list)))
+    print(f"Validation KITTI: {epe:.3f}, {f1:.3f}", flush=True)
+    return {"kitti-epe": epe, "kitti-f1": f1}
+
+
+def create_sintel_submission(variables,
+                             model_cfg: RAFTConfig = RAFTConfig.full(),
+                             iters: int = 32, warm_start: bool = False,
+                             root: str = "datasets/Sintel",
+                             output_path: str = "sintel_submission",
+                             eval_fn=None) -> None:
+    """Write test-split ``.flo`` predictions (reference evaluate.py:22-51).
+
+    ``warm_start``: seed each frame with the previous frame's 1/8-res flow
+    forward-warped along itself (evaluate.py:40-41) — the scattered-data
+    interpolation runs on host.
+    """
+    eval_fn = eval_fn or make_eval_fn(model_cfg, iters)
+    for dstype in ("clean", "final"):
+        ds = datasets.MpiSintel(split="test", aug_params=None,
+                                dstype=dstype, root=root)
+        flow_prev, sequence_prev = None, None
+        for i in range(len(ds)):
+            sample = ds.load(i)
+            sequence, frame = sample["extra_info"]
+            if sequence != sequence_prev:
+                flow_prev = None
+            image1, image2, padder = _prep(sample, "sintel")
+            flow_low, flow_up = eval_fn(variables, image1, image2, flow_prev)
+            flow = np.asarray(padder.unpad(flow_up)[0])
+            if warm_start:
+                flow_prev = jnp.asarray(
+                    forward_interpolate(np.asarray(flow_low[0])))[None]
+            out_dir = osp.join(output_path, dstype, sequence)
+            os.makedirs(out_dir, exist_ok=True)
+            frame_utils.write_flo(
+                osp.join(out_dir, f"frame{frame + 1:04d}.flo"), flow)
+            sequence_prev = sequence
+
+
+def create_kitti_submission(variables,
+                            model_cfg: RAFTConfig = RAFTConfig.full(),
+                            iters: int = 24, root: str = "datasets/KITTI",
+                            output_path: str = "kitti_submission",
+                            eval_fn=None) -> None:
+    """Write test-split 16-bit PNG flow (reference evaluate.py:54-72)."""
+    eval_fn = eval_fn or make_eval_fn(model_cfg, iters)
+    ds = datasets.KITTI(split="testing", aug_params=None, root=root)
+    os.makedirs(output_path, exist_ok=True)
+    for i in range(len(ds)):
+        sample = ds.load(i)
+        (frame_id,) = sample["extra_info"]
+        image1, image2, padder = _prep(sample, "kitti")
+        _, flow_up = eval_fn(variables, image1, image2)
+        flow = np.asarray(padder.unpad(flow_up)[0])
+        frame_utils.write_flow_kitti(osp.join(output_path, frame_id), flow)
+
+
+VALIDATORS = {
+    "chairs": validate_chairs,
+    "sintel": validate_sintel,
+    "kitti": validate_kitti,
+}

@@ -61,3 +61,18 @@ class CheckpointManager:
 
     def close(self) -> None:
         self._mgr.close()
+
+
+def save_variables(path: str, variables: Any) -> None:
+    """Save a bare ``{'params': ..., 'batch_stats': ...}`` pytree (model
+    zoo / converted-weights format — no optimizer state)."""
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), variables)
+    ckptr.wait_until_finished()
+
+
+def load_variables(path: str) -> Any:
+    """Load a bare variables pytree saved by ``save_variables`` (or the
+    torch->pytree converter)."""
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path))

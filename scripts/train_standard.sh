@@ -1,0 +1,11 @@
+#!/bin/bash
+# Full curriculum chairs -> things -> sintel -> kitti
+# (reference train_standard.sh:3-6 hyperparameters; checkpoints are orbax
+# directories and --restore_ckpt seeds weights only, so each stage starts
+# its own LR schedule exactly like the reference's weights-only .pth loads).
+set -e
+mkdir -p checkpoints
+python -u -m raft_tpu.cli.train --name raft-chairs --stage chairs --validation chairs --num_steps 100000 --batch_size 10 --lr 0.0004 --image_size 368 496 --wdecay 0.0001
+python -u -m raft_tpu.cli.train --name raft-things --stage things --validation sintel --restore_ckpt checkpoints/raft-chairs --num_steps 100000 --batch_size 6 --lr 0.000125 --image_size 400 720 --wdecay 0.0001
+python -u -m raft_tpu.cli.train --name raft-sintel --stage sintel --validation sintel --restore_ckpt checkpoints/raft-things --num_steps 100000 --batch_size 6 --lr 0.000125 --image_size 368 768 --wdecay 0.00001 --gamma 0.85
+python -u -m raft_tpu.cli.train --name raft-kitti --stage kitti --validation kitti --restore_ckpt checkpoints/raft-sintel --num_steps 50000 --batch_size 6 --lr 0.0001 --image_size 288 960 --wdecay 0.00001 --gamma 0.85

@@ -1,0 +1,141 @@
+"""End-to-end CLI smoke tests on synthetic corpora: train a few steps,
+evaluate, demo, and the converter CLI round-trip (reference L6 entry-point
+parity, SURVEY.md §1)."""
+
+import os
+import os.path as osp
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from raft_tpu.data import frame_utils
+
+H, W = 96, 128
+
+
+@pytest.fixture
+def chairs_tree(tmp_path):
+    rng = np.random.default_rng(0)
+    data = tmp_path / "datasets" / "FlyingChairs_release" / "data"
+    data.mkdir(parents=True)
+    n = 10
+    for i in range(n):
+        for s in (1, 2):
+            arr = rng.integers(0, 255, size=(H, W, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(data / f"{i:05d}_img{s}.ppm",
+                                      format="PPM")
+        frame_utils.write_flo(
+            str(data / f"{i:05d}_flow.flo"),
+            rng.normal(size=(H, W, 2)).astype(np.float32))
+    split = tmp_path / "chairs_split.txt"
+    split.write_text("1\n" * (n - 1) + "2\n")
+    return tmp_path
+
+
+def test_train_cli_few_steps(chairs_tree, monkeypatch):
+    from raft_tpu.cli import train as train_cli
+
+    monkeypatch.chdir(chairs_tree)
+    train_cli.main([
+        "--name", "smoke", "--stage", "chairs", "--small",
+        "--num_steps", "2", "--batch_size", "8",
+        "--image_size", "64", "96", "--iters", "2",
+        "--precision", "fp32",
+        "--data_root", str(chairs_tree / "datasets"),
+        "--chairs_split", str(chairs_tree / "chairs_split.txt"),
+        "--ckpt_dir", str(chairs_tree / "ckpts"),
+        "--num_workers", "2",
+    ])
+    run_dir = chairs_tree / "ckpts" / "smoke"
+    assert run_dir.exists()
+    steps = [d for d in os.listdir(run_dir) if d.isdigit()]
+    assert steps, os.listdir(run_dir)
+
+    # Evaluating straight from a training-run checkpoint directory must
+    # work (orbax <dir>/<step>/default layout + TrainState stripping).
+    from raft_tpu.cli import evaluate as eval_cli
+
+    eval_cli.main([
+        "--model", str(run_dir), "--dataset", "chairs", "--small",
+        "--precision", "fp32", "--iters", "2",
+        "--data_root", str(chairs_tree / "datasets"),
+        "--chairs_split", str(chairs_tree / "chairs_split.txt"),
+    ])
+
+
+def test_evaluate_and_demo_cli(chairs_tree, tmp_path, monkeypatch):
+    import jax
+
+    from raft_tpu.cli import demo as demo_cli
+    from raft_tpu.cli import evaluate as eval_cli
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.train.checkpoint import save_variables
+
+    cfg = RAFTConfig.small_model()
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img = jax.numpy.zeros((1, 64, 96, 3))
+    variables = model.init({"params": rng, "dropout": rng}, img, img,
+                           iters=1)
+    ckpt = str(tmp_path / "ckpt")
+    save_variables(ckpt, {"params": variables["params"],
+                          "batch_stats":
+                          dict(variables.get("batch_stats", {}))})
+
+    eval_cli.main([
+        "--model", ckpt, "--dataset", "chairs", "--small",
+        "--precision", "fp32", "--iters", "2",
+        "--data_root", str(chairs_tree / "datasets"),
+        "--chairs_split", str(chairs_tree / "chairs_split.txt"),
+    ])
+
+    frames = tmp_path / "frames"
+    frames.mkdir()
+    rng_np = np.random.default_rng(1)
+    for i in range(3):
+        arr = rng_np.integers(0, 255, size=(H, W, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(frames / f"f{i:02d}.png")
+    out = tmp_path / "demo-out"
+    demo_cli.main(["--model", ckpt, "--path", str(frames),
+                   "--out", str(out), "--small", "--precision", "fp32",
+                   "--iters", "2"])
+    written = sorted(os.listdir(out))
+    assert written == ["f00_flow.png", "f01_flow.png"]
+    img0 = np.asarray(Image.open(out / "f00_flow.png"))
+    assert img0.shape == (2 * H, W, 3)
+
+
+def test_lk_compare_cli(tmp_path):
+    import jax
+
+    from raft_tpu.cli import lk_compare
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.train.checkpoint import save_variables
+
+    cfg = RAFTConfig.small_model()
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img = jax.numpy.zeros((1, 64, 96, 3))
+    variables = model.init({"params": rng, "dropout": rng}, img, img,
+                           iters=1)
+    ckpt = str(tmp_path / "ckpt")
+    save_variables(ckpt, {"params": variables["params"],
+                          "batch_stats":
+                          dict(variables.get("batch_stats", {}))})
+
+    rng_np = np.random.default_rng(2)
+    base = rng_np.integers(0, 255, size=(H, W, 3), dtype=np.uint8)
+    shifted = np.roll(base, 3, axis=1)
+    p1, p2 = tmp_path / "a.png", tmp_path / "b.png"
+    Image.fromarray(base).save(p1)
+    Image.fromarray(shifted).save(p2)
+    out = tmp_path / "cmp.png"
+    lk_compare.main(["--model", ckpt, "--image1", str(p1),
+                     "--image2", str(p2), "--out", str(out),
+                     "--small", "--iters", "2"])
+    assert out.exists()
+    side = np.asarray(Image.open(out))
+    assert side.shape == (H, 2 * W, 3)

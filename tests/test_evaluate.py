@@ -1,0 +1,135 @@
+"""Evaluation-module tests on synthetic dataset trees (SURVEY.md §4: the
+reference has no tests; validators are checked end-to-end on tiny corpora
+with the small model at few iters)."""
+
+import os.path as osp
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from raft_tpu import evaluate
+from raft_tpu.config import RAFTConfig
+from raft_tpu.data import frame_utils
+from raft_tpu.models.raft import RAFT
+
+H, W = 48, 64
+CFG = RAFTConfig.small_model()
+
+
+def _write_img(path, rng, size=(H, W)):
+    arr = rng.integers(0, 255, size=size + (3,), dtype=np.uint8)
+    Image.fromarray(arr).save(path)
+
+
+@pytest.fixture(scope="module")
+def variables():
+    import jax
+
+    model = RAFT(CFG)
+    rng = jax.random.PRNGKey(0)
+    img = jax.numpy.zeros((1, H, W, 3))
+    return model.init({"params": rng, "dropout": rng}, img, img, iters=1)
+
+
+@pytest.fixture
+def sintel_root(tmp_path):
+    rng = np.random.default_rng(0)
+    for split in ("training", "test"):
+        for scene in ("alley_1",):
+            img_dir = tmp_path / "Sintel" / split / "clean" / scene
+            img_dir.mkdir(parents=True)
+            (tmp_path / "Sintel" / split / "final" / scene).mkdir(
+                parents=True)
+            for i in range(3):
+                _write_img(img_dir / f"frame_{i:04d}.png", rng)
+                _write_img(tmp_path / "Sintel" / split / "final" / scene /
+                           f"frame_{i:04d}.png", rng)
+            if split == "training":
+                flow_dir = tmp_path / "Sintel/training/flow" / scene
+                flow_dir.mkdir(parents=True)
+                for i in range(2):
+                    frame_utils.write_flo(
+                        str(flow_dir / f"frame_{i:04d}.flo"),
+                        rng.normal(size=(H, W, 2)).astype(np.float32))
+    return str(tmp_path / "Sintel")
+
+
+@pytest.fixture
+def kitti_root(tmp_path):
+    rng = np.random.default_rng(1)
+    for split in ("training", "testing"):
+        img_dir = tmp_path / "KITTI" / split / "image_2"
+        img_dir.mkdir(parents=True)
+        for i in range(2):
+            _write_img(img_dir / f"{i:06d}_10.png", rng)
+            _write_img(img_dir / f"{i:06d}_11.png", rng)
+        if split == "training":
+            flow_dir = tmp_path / "KITTI/training/flow_occ"
+            flow_dir.mkdir(parents=True)
+            for i in range(2):
+                frame_utils.write_flow_kitti(
+                    str(flow_dir / f"{i:06d}_10.png"),
+                    rng.normal(scale=5, size=(H, W, 2)).astype(np.float32))
+    return str(tmp_path / "KITTI")
+
+
+@pytest.fixture
+def chairs_root(tmp_path):
+    rng = np.random.default_rng(2)
+    data = tmp_path / "FlyingChairs_release/data"
+    data.mkdir(parents=True)
+    for i in range(2):
+        arr = rng.integers(0, 255, size=(H, W, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(data / f"{i:05d}_img1.ppm", format="PPM")
+        Image.fromarray(arr).save(data / f"{i:05d}_img2.ppm", format="PPM")
+        frame_utils.write_flo(str(data / f"{i:05d}_flow.flo"),
+                              rng.normal(size=(H, W, 2)).astype(np.float32))
+    split = tmp_path / "chairs_split.txt"
+    split.write_text("2\n2\n")
+    return str(data), str(split)
+
+
+def test_validate_sintel(variables, sintel_root):
+    res = evaluate.validate_sintel(variables, CFG, iters=2, root=sintel_root)
+    assert set(res) == {"clean", "final"}
+    for v in res.values():
+        assert np.isfinite(v) and v >= 0
+
+
+def test_validate_kitti(variables, kitti_root):
+    res = evaluate.validate_kitti(variables, CFG, iters=2, root=kitti_root)
+    assert np.isfinite(res["kitti-epe"])
+    assert 0.0 <= res["kitti-f1"] <= 100.0
+
+
+def test_validate_chairs(variables, chairs_root):
+    root, split_file = chairs_root
+    res = evaluate.validate_chairs(variables, CFG, iters=2, root=root,
+                                   split_file=split_file)
+    assert np.isfinite(res["chairs"])
+
+
+def test_sintel_submission_warm_start(variables, sintel_root, tmp_path):
+    out = str(tmp_path / "submission")
+    evaluate.create_sintel_submission(variables, CFG, iters=2,
+                                      warm_start=True, root=sintel_root,
+                                      output_path=out)
+    # 2 pairs per scene per dstype, frames numbered from 1.
+    for dstype in ("clean", "final"):
+        for frame in (1, 2):
+            path = osp.join(out, dstype, "alley_1", f"frame{frame:04d}.flo")
+            flow = frame_utils.read_flo(path)
+            assert flow.shape == (H, W, 2)
+            assert np.isfinite(flow).all()
+
+
+def test_kitti_submission(variables, kitti_root, tmp_path):
+    out = str(tmp_path / "ksub")
+    evaluate.create_kitti_submission(variables, CFG, iters=2,
+                                     root=kitti_root, output_path=out)
+    for i in range(2):
+        flow, valid = frame_utils.read_flow_kitti(
+            osp.join(out, f"{i:06d}_10.png"))
+        assert flow.shape == (H, W, 2)
+        assert valid.all()
