@@ -70,8 +70,12 @@ class RefinementStep(nn.Module):
                                        cfg.corr_radius,
                                        block_size=cfg.corr_block_size)
         elif cfg.corr_impl == "pallas":
-            raise NotImplementedError(
-                "corr_impl='pallas' is not wired up yet; use 'chunked'")
+            from raft_tpu.ops.pallas_corr import pallas_corr_lookup
+
+            fmap1, f2_pyramid = corr_state
+            corr = pallas_corr_lookup(fmap1, tuple(f2_pyramid), coords1,
+                                      cfg.corr_radius,
+                                      min(cfg.corr_block_size, 128))
         else:
             raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")
 

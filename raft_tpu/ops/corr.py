@@ -19,8 +19,11 @@ TPU design:
 - The all-pairs volume is one big einsum -> MXU.  Stored as
   ``(B, H1*W1, H2_l, W2_l)`` fp32 per level (reference casts corr to fp32,
   corr.py:50).
-- Window lookup is 4 corner gathers + lerp (``align_corners=True`` zeros
-  padding, matching ``bilinear_sampler`` at corr.py:45).
+- Window lookup: bilinear sampling is linear in the correlation rows, so
+  the ``(2r+1)^2`` taps factorize into two dense 1-D interpolation-weight
+  mat-muls (``_sample_windows``) — no gathers (TPU gathers lower to serial
+  loops).  Semantics match ``align_corners=True`` zeros-padding
+  ``bilinear_sampler`` (corr.py:45) exactly.
 - The memory-efficient path (``chunked_corr_lookup``) is blockwise: for a
   block of query pixels, compute its corr rows against pooled ``f2`` levels
   (small MXU matmuls), sample the windows, and discard the rows — the
@@ -77,18 +80,31 @@ def build_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array,
     return pyramid
 
 
-def _window_offsets(radius: int, dtype=jnp.float32) -> jax.Array:
-    """``(2r+1, 2r+1, 2)`` offsets; axis 0 walks x, axis 1 walks y (see
-    module docstring ordering contract)."""
-    d = jnp.arange(-radius, radius + 1, dtype=dtype)
-    dx = jnp.broadcast_to(d[:, None], (2 * radius + 1, 2 * radius + 1))
-    dy = jnp.broadcast_to(d[None, :], (2 * radius + 1, 2 * radius + 1))
-    return jnp.stack([dx, dy], axis=-1)
+def _interp_weights_1d(c: jax.Array, n: int, radius: int) -> jax.Array:
+    """Dense bilinear interpolation weights along one axis.
+
+    ``w[..., t, p] = max(0, 1 - |c + t - r - p|)`` for positions
+    ``p in [0, n)`` — each window tap has <=2 nonzero weights (the two
+    neighboring pixels) and out-of-bounds taps get all-zero rows, which is
+    exactly ``grid_sample(align_corners=True, padding='zeros')``
+    (reference utils.py:57-65).
+    """
+    k = 2 * radius + 1
+    taps = jnp.arange(k, dtype=jnp.float32) - radius
+    pos = jnp.arange(n, dtype=jnp.float32)
+    return jnp.maximum(
+        0.0, 1.0 - jnp.abs(c[..., None, None] + taps[:, None] - pos))
 
 
 def _sample_windows(corr: jax.Array, coords: jax.Array,
                     radius: int) -> jax.Array:
-    """Bilinear window gather via the shared zeros-padding sampler.
+    """Bilinear window sampling as two batched mat-muls (MXU-friendly).
+
+    Bilinear interpolation is linear in the image, so the ``(2r+1)^2``
+    window taps factorize into dense 1-D weight matrices contracted
+    against the correlation rows — no gathers (TPU gathers lower to serial
+    loops; this formulation is the reason the lookup is fast on TPU, and
+    the same math the Pallas kernel uses).
 
     Args:
       corr: ``(B, N, H, W)`` one pyramid level (N query pixels).
@@ -97,15 +113,18 @@ def _sample_windows(corr: jax.Array, coords: jax.Array,
     Returns:
       ``(B, N, (2r+1)^2)`` sampled taps, x-major tap order.
     """
-    from raft_tpu.ops.sampler import bilinear_sampler
-
     B, N, H, W = corr.shape
     K = 2 * radius + 1
-    win = coords[:, :, None, None, :] + _window_offsets(radius, coords.dtype)
-    # Fold the per-query axis into batch and reuse the one bilinear contract.
-    img = corr.reshape(B * N, H, W, 1)
-    out = bilinear_sampler(img, win.reshape(B * N, K, K, 2))
-    return out.reshape(B, N, K * K)
+    c = coords.astype(jnp.float32)
+    wx = _interp_weights_1d(c[..., 0], W, radius)     # (B, N, K, W)
+    wy = _interp_weights_1d(c[..., 1], H, radius)     # (B, N, K, H)
+    # a(b,n,j,x) = sum_y wy(b,n,j,y) corr(b,n,y,x)
+    a = jnp.einsum("bnjy,bnyx->bnjx", wy, corr.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    # tap(b,n,i,j) = sum_x wx(b,n,i,x) a(b,n,j,x)
+    taps = jnp.einsum("bnix,bnjx->bnij", wx, a,
+                      preferred_element_type=jnp.float32)
+    return taps.reshape(B, N, K * K)
 
 
 def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,

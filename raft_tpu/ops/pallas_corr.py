@@ -1,0 +1,324 @@
+"""Fused on-demand correlation lookup as Pallas TPU kernels.
+
+The TPU-native replacement for the reference's ``alt_cuda_corr`` extension
+(``alt_cuda_corr/correlation_kernel.cu:19-256``, SURVEY.md C6) — and,
+unlike the reference (whose backward kernel exists but is never wired into
+autograd, correlation.cpp:51-54), fully differentiable via
+``jax.custom_vjp``.
+
+Math redesign for the MXU (no gathers, no scatters, no atomics):
+
+For one pyramid level with pooled target features ``f2 (Hl*Wl, C)``, query
+features ``f1 (N, C)`` and window centroids ``c = coords / 2^l``:
+
+    rows(q, y, x) = <f1_q, f2[y, x]> / sqrt(C)          (MXU matmul)
+    tap(q, i, j)  = bilinear(rows(q), c_q + (i - r, j - r))
+
+Bilinear sampling with zeros padding is a *linear* map of the row image, so
+it factorizes into two dense 1-D interpolation matrices:
+
+    wx(q, i, x) = max(0, 1 - |c_q.x + i - r - x|)       (BQ, K, Wl)
+    wy(q, j, y) = max(0, 1 - |c_q.y + j - r - y|)       (BQ, K, Hl)
+    tap(q,i,j)  = sum_{y,x} wy(q,j,y) * rows(q,y,x) * wx(q,i,x)
+
+i.e. two batched mat-muls per level — the gather-heavy CUDA design
+(dynamic ``floor(coords)`` windows + bilinear *scatter* with shared-memory
+staging, correlation_kernel.cu:55-114) becomes three MXU contractions, and
+out-of-bounds taps fall out as zero weights (the sampler's zeros-padding
+semantics, utils.py:57-65).  The backward pass is the transpose of the
+same contractions; ``coords`` gets zero gradient by design, matching the
+per-iteration ``coords1.detach()`` truncation (raft.py:123) and the CUDA
+kernel's never-filled ``coords_grad`` (correlation_kernel.cu:307).
+
+Layout contract: tap order is x-major (``i`` walks x), levels concatenated
+level-major — identical to ``raft_tpu.ops.corr`` and the reference
+(corr.py:36-41).
+
+Blocking: queries are processed in ``block_q`` chunks (grid = (B, N/BQ));
+each kernel instance holds one level's ``f2`` and one query block's rows in
+VMEM.  The correlation volume never exists in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _tap_weight(c: jax.Array, offset: float, pos: jax.Array) -> jax.Array:
+    """Bilinear weight ``max(0, 1 - |c + offset - pos|)`` (zeros padding
+    falls out as all-zero weights for out-of-range taps)."""
+    return jnp.maximum(0.0, 1.0 - jnp.abs(c + offset - pos))
+
+
+def _fwd_kernel(f1_ref, c_ref, f2_ref, out_ref, *, hl, wl, k, inv_scale,
+                lvl_div):
+    """Mosaic-friendly layout: queries live in the LANE dim everywhere
+    (lane-dim reshapes and mismatched-batch dots are unsupported).  The
+    kernel streams f2 row-by-row: one (Wl, C) x (C, BQ) mat-mul per image
+    row, accumulated into the window taps with per-row bilinear weights —
+    the correlation rows never exist at once, not even in VMEM."""
+    f1 = f1_ref[0]                      # (BQ, C)
+    bq = f1.shape[0]
+    r = (k - 1) // 2
+    cx = c_ref[0, :, 0] * lvl_div       # (BQ,)
+    cy = c_ref[0, :, 1] * lvl_div
+    posx = jax.lax.broadcasted_iota(jnp.int32, (wl, bq), 0) \
+        .astype(jnp.float32)            # (Wl, BQ)
+
+    def body(y, acc):
+        f2_y = f2_ref[0, y]             # (Wl, C)
+        rows_y = jax.lax.dot_general(
+            f2_y, f1, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * inv_scale   # (Wl, BQ)
+        yf = y.astype(jnp.float32)
+        # acc(j, x, q) += wy_j(q) * rows_y(x, q)
+        return acc + jnp.stack(
+            [(_tap_weight(cy, j - r, yf))[None, :] * rows_y
+             for j in range(k)])
+
+    a = jax.lax.fori_loop(
+        0, hl, body, jnp.zeros((k, wl, bq), jnp.float32))   # (K_j, Wl, BQ)
+
+    # Contract x with a ones-row mat-mul: Mosaic can't emit sublane
+    # reductions with 1-D outputs, but (1, Wl) @ (Wl, BQ) is plain MXU.
+    ones_row = jnp.ones((1, wl), jnp.float32)
+    for i in range(k):
+        wx_i = _tap_weight(cx[None, :], float(i - r), posx)  # (Wl, BQ)
+        for j in range(k):
+            out_ref[0, 0, i, j:j + 1, :] = jax.lax.dot_general(
+                ones_row, wx_i * a[j], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)          # (1, BQ)
+
+
+def _bwd_kernel(f1_ref, c_ref, f2_ref, g_ref, df1_ref, df2_ref, *,
+                hl, wl, k, inv_scale, lvl_div):
+    """Transpose of the forward row-streaming: per image row y,
+    ``drows_y(x, q) = sum_ij g(i,j,q) wx_i(x,q) wy_j(y,q)`` feeds two 2-D
+    mat-muls — ``df1 += drows_y @ f2_y`` and ``df2[y] = drows_y^T-style
+    contraction over queries`` (accumulated across query blocks; the TPU
+    grid runs sequentially, so no atomics are needed — unlike the
+    reference's atomicAdd scatter, correlation_kernel.cu:237)."""
+    i = pl.program_id(1)
+    f1 = f1_ref[0]                      # (BQ, C)
+    bq = f1.shape[0]
+    r = (k - 1) // 2
+    g = g_ref[0, 0]                     # (K_i, K_j, BQ)
+    cx = c_ref[0, :, 0] * lvl_div
+    cy = c_ref[0, :, 1] * lvl_div
+    posx = jax.lax.broadcasted_iota(jnp.int32, (wl, bq), 0) \
+        .astype(jnp.float32)
+
+    # b_j(x, q) = sum_i wx_i(x, q) g(i, j, q)
+    b = [
+        sum((_tap_weight(cx[None, :], float(ti - r), posx)
+             * g[ti, tj][None, :]) for ti in range(k))
+        for tj in range(k)
+    ]                                    # K_j x (Wl, BQ)
+
+    @pl.when(i == 0)
+    def _():
+        df2_ref[0] = jnp.zeros_like(df2_ref[0])
+
+    def body(y, df1):
+        yf = y.astype(jnp.float32)
+        drows_y = sum(
+            (_tap_weight(cy, tj - r, yf))[None, :] * b[tj]
+            for tj in range(k)) * inv_scale              # (Wl, BQ)
+        f2_y = f2_ref[0, y]                              # (Wl, C)
+        # df1(q, c) += sum_x drows_y(x, q) f2_y(x, c)
+        df1 = df1 + jax.lax.dot_general(
+            drows_y, f2_y, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (BQ, C)
+        # df2(y, x, c) += sum_q drows_y(x, q) f1(q, c)
+        df2_ref[0, y] += jax.lax.dot_general(
+            drows_y, f1, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (Wl, C)
+        return df1
+
+    df1_ref[0] = jax.lax.fori_loop(
+        0, hl, body, jnp.zeros((bq, f1.shape[-1]), jnp.float32))
+
+
+def _pad_queries(f1, coords, block_q):
+    B, N, C = f1.shape
+    nblocks = -(-N // block_q)
+    pad = nblocks * block_q - N
+    if pad:
+        f1 = jnp.pad(f1, ((0, 0), (0, pad), (0, 0)))
+        # Far-out-of-range centers make every window weight zero.
+        coords = jnp.pad(coords, ((0, 0), (0, pad), (0, 0)),
+                         constant_values=-1e6)
+    return f1, coords, nblocks
+
+
+def _level_fwd(f1p, coords_p, f2, level, radius, block_q, interpret):
+    B, Npad, C = f1p.shape
+    _, hl, wl, _ = f2.shape
+    k = 2 * radius + 1
+    nblocks = Npad // block_q
+    kern = functools.partial(
+        _fwd_kernel, hl=hl, wl=wl, k=k,
+        inv_scale=1.0 / float(C) ** 0.5, lvl_div=1.0 / (2.0 ** level))
+    out = pl.pallas_call(
+        kern,
+        grid=(B, nblocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hl, wl, C), lambda b, i: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        # Taps are emitted query-last (K_i, K_j, BQ) so queries stay in
+        # lanes; the cheap transpose back to query-major happens in XLA.
+        out_specs=pl.BlockSpec((1, 1, k, k, block_q),
+                               lambda b, i: (b, i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, nblocks, k, k, block_q),
+                                       jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(f1p, coords_p, f2.astype(jnp.float32))
+    # (B, nblocks, K, K, BQ) -> (B, Npad, K*K)
+    return out.transpose(0, 1, 4, 2, 3).reshape(B, Npad, k * k)
+
+
+def _level_bwd(f1p, coords_p, f2, g, level, radius, block_q, interpret):
+    """``g``: (B, nblocks, K, K, BQ) query-last cotangent blocks."""
+    B, Npad, C = f1p.shape
+    _, hl, wl, _ = f2.shape
+    k = 2 * radius + 1
+    nblocks = Npad // block_q
+    kern = functools.partial(
+        _bwd_kernel, hl=hl, wl=wl, k=k,
+        inv_scale=1.0 / float(C) ** 0.5, lvl_div=1.0 / (2.0 ** level))
+    return pl.pallas_call(
+        kern,
+        grid=(B, nblocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hl, wl, C), lambda b, i: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, k, k, block_q),
+                         lambda b, i: (b, i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hl, wl, C), lambda b, i: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, Npad, C), jnp.float32),
+            jax.ShapeDtypeStruct((B, hl, wl, C), jnp.float32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(f1p, coords_p, f2.astype(jnp.float32), g)
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def pallas_corr_lookup(fmap1, fmap2_pyramid, coords, radius: int = 4,
+                       block_q: int = 128, interpret=None):
+    """Fused on-demand pyramid correlation lookup.
+
+    Args:
+      fmap1: ``(B, H1, W1, C)`` query features.
+      fmap2_pyramid: sequence of pooled target features
+        ``(B, Hl, Wl, C)`` (from :func:`raft_tpu.ops.corr.pool_fmap_pyramid`).
+      coords: ``(B, H1, W1, 2)`` level-0 centroids, last axis ``(x, y)``.
+      radius: window radius r.
+      block_q: query pixels per kernel instance (MXU-aligned).
+      interpret: force pallas interpreter (default: auto — on for non-TPU
+        backends so tests run on CPU).
+
+    Returns:
+      ``(B, H1, W1, L * (2r+1)^2)`` fp32 lookup features.
+    """
+    out, _ = _corr_fwd(fmap1, fmap2_pyramid, coords, radius, block_q,
+                       interpret)
+    return out
+
+
+def _corr_fwd(fmap1, fmap2_pyramid, coords, radius, block_q, interpret):
+    if interpret is None:
+        interpret = _auto_interpret()
+    B, H1, W1, C = fmap1.shape
+    N = H1 * W1
+    k = 2 * radius + 1
+    f1 = fmap1.reshape(B, N, C).astype(jnp.float32)
+    c = coords.reshape(B, N, 2).astype(jnp.float32)
+    f1p, cp, _ = _pad_queries(f1, c, block_q)
+
+    Npad = f1p.shape[1]
+    outs = []
+    for lvl, f2 in enumerate(fmap2_pyramid):
+        _, hl, wl, _ = f2.shape
+        if hl == 0 or wl == 0:
+            # Over-pooled tiny input: an empty level samples as all zeros
+            # (zeros-padding semantics).
+            outs.append(jnp.zeros((B, Npad, k * k), jnp.float32))
+            continue
+        outs.append(_level_fwd(f1p, cp, f2, lvl, radius, block_q,
+                               interpret))
+    out = jnp.concatenate([o[:, :N] for o in outs], axis=-1)
+    out = out.reshape(B, H1, W1, len(outs) * k * k)
+    return out, (fmap1, tuple(fmap2_pyramid), coords)
+
+
+def _corr_bwd(radius, block_q, interpret, residuals, g):
+    fmap1, fmap2_pyramid, coords = residuals
+    if interpret is None:
+        interpret = _auto_interpret()
+    B, H1, W1, C = fmap1.shape
+    N = H1 * W1
+    k = 2 * radius + 1
+    f1 = fmap1.reshape(B, N, C).astype(jnp.float32)
+    c = coords.reshape(B, N, 2).astype(jnp.float32)
+    f1p, cp, nblocks = _pad_queries(f1, c, block_q)
+    Npad = f1p.shape[1]
+
+    g = g.reshape(B, N, -1).astype(jnp.float32)
+    if Npad != N:
+        g = jnp.pad(g, ((0, 0), (0, Npad - N), (0, 0)))
+
+    nblocks = Npad // block_q
+    df1 = jnp.zeros((B, Npad, C), jnp.float32)
+    df2s = []
+    for lvl, f2 in enumerate(fmap2_pyramid):
+        _, hl, wl, _ = f2.shape
+        if hl == 0 or wl == 0:
+            df2s.append(jnp.zeros_like(f2))
+            continue
+        # (B, Npad, K*K) -> query-last blocks (B, nblocks, K, K, BQ)
+        g_l = g[:, :, lvl * k * k:(lvl + 1) * k * k] \
+            .reshape(B, nblocks, block_q, k, k).transpose(0, 1, 3, 4, 2)
+        df1_l, df2_l = _level_bwd(f1p, cp, f2, g_l, lvl, radius,
+                                  block_q, interpret)
+        df1 = df1 + df1_l
+        df2s.append(df2_l.astype(f2.dtype))
+
+    df1 = df1[:, :N].reshape(fmap1.shape).astype(fmap1.dtype)
+    # coords gradient is structurally zero (reference detaches coords each
+    # iteration, raft.py:123; CUDA kernel never fills coords_grad).
+    return df1, tuple(df2s), jnp.zeros_like(coords)
+
+
+pallas_corr_lookup.defvjp(_corr_fwd, _corr_bwd)

@@ -1,0 +1,104 @@
+"""Pallas correlation kernel: equivalence vs the materialized XLA path and
+real gradients (the reference never tests that its two corr paths agree,
+SURVEY.md §4; its CUDA backward is unwired, C6 — ours must be correct).
+
+Runs in pallas interpreter mode on the CPU test backend.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.ops.corr import (build_corr_pyramid, chunked_corr_lookup,
+                               corr_lookup, pool_fmap_pyramid)
+from raft_tpu.ops.pallas_corr import pallas_corr_lookup
+from raft_tpu.ops.sampler import coords_grid
+
+B, H, W, C = 2, 12, 16, 32
+LEVELS, RADIUS = 3, 3
+
+
+def _setup(seed=0):
+    rng = np.random.default_rng(seed)
+    f1 = jnp.asarray(rng.standard_normal((B, H, W, C)), jnp.float32)
+    f2 = jnp.asarray(rng.standard_normal((B, H, W, C)), jnp.float32)
+    coords = coords_grid(B, H, W) + jnp.asarray(
+        rng.uniform(-2, 2, (B, H, W, 2)), jnp.float32)
+    return f1, f2, coords
+
+
+def test_matches_materialized_lookup():
+    f1, f2, coords = _setup()
+    pyr = build_corr_pyramid(f1, f2, LEVELS)
+    want = np.asarray(corr_lookup(pyr, coords, RADIUS))
+    f2_pyr = tuple(pool_fmap_pyramid(f2, LEVELS))
+    got = np.asarray(pallas_corr_lookup(f1, f2_pyr, coords, RADIUS, 64))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_matches_chunked_lookup():
+    f1, f2, coords = _setup(1)
+    f2_pyr = tuple(pool_fmap_pyramid(f2, LEVELS))
+    want = np.asarray(chunked_corr_lookup(f1, f2_pyr, coords, RADIUS,
+                                          block_size=32))
+    got = np.asarray(pallas_corr_lookup(f1, f2_pyr, coords, RADIUS, 64))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_padding_and_block_sizes():
+    # N = 12*16 = 192; block 128 forces a ragged final block.
+    f1, f2, coords = _setup(2)
+    f2_pyr = tuple(pool_fmap_pyramid(f2, LEVELS))
+    a = np.asarray(pallas_corr_lookup(f1, f2_pyr, coords, RADIUS, 128))
+    b = np.asarray(pallas_corr_lookup(f1, f2_pyr, coords, RADIUS, 64))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_gradients_match_xla_path():
+    f1, f2, coords = _setup(3)
+
+    def loss_pallas(f1_, f2_):
+        pyr = tuple(pool_fmap_pyramid(f2_, LEVELS))
+        out = pallas_corr_lookup(f1_, pyr, coords, RADIUS, 64)
+        return jnp.sum(jnp.sin(out))
+
+    def loss_xla(f1_, f2_):
+        pyr = build_corr_pyramid(f1_, f2_, LEVELS)
+        out = corr_lookup(pyr, coords, RADIUS)
+        return jnp.sum(jnp.sin(out))
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1))(f1, f2)
+    gx = jax.grad(loss_xla, argnums=(0, 1))(f1, f2)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_coords_gradient_is_zero():
+    f1, f2, coords = _setup(4)
+    f2_pyr = tuple(pool_fmap_pyramid(f2, LEVELS))
+
+    g = jax.grad(lambda c: jnp.sum(
+        pallas_corr_lookup(f1, f2_pyr, c, RADIUS, 64)))(coords)
+    assert np.all(np.asarray(g) == 0.0)
+
+
+def test_model_with_pallas_corr_runs():
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    cfg = RAFTConfig.small_model(corr_impl="pallas")
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img = jax.random.uniform(rng, (1, 48, 64, 3)) * 255.0
+    variables = model.init({"params": rng, "dropout": rng}, img, img,
+                           iters=1)
+    flows = model.apply(variables, img, img, iters=2)
+    assert flows.shape == (2, 1, 48, 64, 2)
+    assert np.isfinite(np.asarray(flows)).all()
+
+    cfg_ref = RAFTConfig.small_model(corr_impl="allpairs")
+    flows_ref = RAFT(cfg_ref).apply(variables, img, img, iters=2)
+    np.testing.assert_allclose(np.asarray(flows), np.asarray(flows_ref),
+                               rtol=1e-4, atol=1e-4)
