@@ -366,9 +366,26 @@ class ShardedLoader:
             np.random.SeedSequence([self.seed, epoch, int(index)]))
         return self.dataset.load(int(index), rng)
 
-    def batches(self, start_epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    def steps_per_epoch(self) -> int:
+        """Per-host batches per epoch (constant across epochs: the global
+        permutation is resharded but its length never changes)."""
+        n = len(self.epoch_indices(0))
+        return n // self.batch_size if self.drop_last \
+            else -(-n // self.batch_size)
+
+    def batches_from_step(self, step: int) -> Iterator[Dict[str, np.ndarray]]:
+        """Resume the stream as if ``step`` batches had already been drawn —
+        auto-resume continues the shuffle instead of replaying epoch 0."""
+        spe = self.steps_per_epoch()
+        start_epoch, skip = divmod(step, spe)
+        return self.batches(start_epoch, skip_batches=skip)
+
+    def batches(self, start_epoch: int = 0,
+                skip_batches: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         """Infinite batch stream, epoch after epoch (the reference wraps its
-        loader in an outer while-loop, train.py:161-208)."""
+        loader in an outer while-loop, train.py:161-208).  ``skip_batches``
+        drops the first batches of the first epoch without decoding them
+        (checkpoint resume mid-epoch)."""
         from collections import deque
 
         epoch = start_epoch
@@ -382,6 +399,15 @@ class ShardedLoader:
                 n = len(idx)
                 usable = (n // self.batch_size) * self.batch_size \
                     if self.drop_last else n
+                if usable == 0:
+                    raise ValueError(
+                        f"per-host dataset share ({n} samples) smaller than "
+                        f"batch_size={self.batch_size} with drop_last — no "
+                        "batches would ever be produced")
+                if epoch == start_epoch and skip_batches:
+                    skipped = min(skip_batches * self.batch_size, usable)
+                    idx = idx[skipped:]
+                    usable -= skipped
                 pending = deque()
                 it = iter(idx[:usable])
                 for i in it:

@@ -19,6 +19,7 @@ class Logger:
         self.log_freq = log_freq
         self.lr_fn = lr_fn
         self._pending: list = []  # device arrays; pulled once per interval
+        self._last_step = 0
         self._writer = None
         self._tb_dir = tensorboard_dir
 
@@ -36,21 +37,28 @@ class Logger:
         # Keep the device arrays; converting here would block on the jitted
         # step every iteration and kill the async dispatch pipeline.
         self._pending.append(metrics)
+        self._last_step = step
         if len(self._pending) >= self.log_freq:
-            count = len(self._pending)
-            sums: Dict[str, float] = {}
-            for m in self._pending:  # one sync per interval, not per step
-                for k, v in m.items():
-                    sums[k] = sums.get(k, 0.0) + float(np.asarray(v))
-            means = {k: s / count for k, s in sums.items()}
-            lr = self.lr_fn(step) if self.lr_fn else float("nan")
-            body = ", ".join(f"{k} {v:10.4f}" for k, v in sorted(means.items()))
-            print(f"[{step + 1:6d}, {lr:10.7f}] {body}", flush=True)
-            w = self._ensure_writer()
-            if w is not None:
-                for k, v in means.items():
-                    w.add_scalar(k, v, step + 1)
-            self._pending = []
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        step = self._last_step
+        count = len(self._pending)
+        sums: Dict[str, float] = {}
+        for m in self._pending:  # one sync per interval, not per step
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + float(np.asarray(v))
+        means = {k: s / count for k, s in sums.items()}
+        lr = self.lr_fn(step) if self.lr_fn else float("nan")
+        body = ", ".join(f"{k} {v:10.4f}" for k, v in sorted(means.items()))
+        print(f"[{step + 1:6d}, {lr:10.7f}] {body}", flush=True)
+        w = self._ensure_writer()
+        if w is not None:
+            for k, v in means.items():
+                w.add_scalar(k, v, step + 1)
+        self._pending = []
 
     def write_dict(self, step: int, results: Dict[str, float]) -> None:
         """Validation results (reference write_dict, train.py:125-130)."""
@@ -62,5 +70,6 @@ class Logger:
                 w.add_scalar(k, v, step)
 
     def close(self) -> None:
+        self._flush()  # trailing partial interval (num_steps % log_freq)
         if self._writer is not None:
             self._writer.close()

@@ -43,26 +43,32 @@ def add_image_noise(rng: np.random.Generator, batch: Dict) -> Dict:
 
 
 def train(model_cfg: RAFTConfig, cfg: TrainConfig,
-          batches, *,
+          batches=None, *,
+          loader=None,
           validators: Optional[Dict[str, Callable]] = None,
           restore_params=None,
           tensorboard_dir: Optional[str] = None,
           mesh=None) -> TrainState:
     """Run the full training loop.
 
-    ``batches``: iterator of host batches (dicts of NHWC numpy arrays) —
-    normally ``ShardedLoader(...).batches()``.
+    ``batches``: iterator of host batches (dicts of NHWC numpy arrays).
+    ``loader``: alternatively a ``ShardedLoader`` — preferred, because on
+    checkpoint auto-resume the stream continues from the restored step's
+    position in the shuffle instead of replaying epoch 0.
     ``validators``: name -> fn(variables) -> dict, run every ``val_freq``
     steps (reference train.py:190-196).
     ``restore_params``: optional {'params', 'batch_stats'} to seed from a
     previous curriculum stage (reference --restore_ckpt, train.py:141-142).
     """
+    assert (batches is None) != (loader is None), \
+        "pass exactly one of batches= or loader="
     mesh = mesh or make_mesh()
     model = RAFT(model_cfg)
     tx = make_optimizer(cfg.lr, cfg.num_steps, cfg.wdecay, cfg.epsilon,
                         cfg.clip)
-    state = init_state(model, tx, jax.random.PRNGKey(cfg.seed),
-                       cfg.image_size)
+    # Tiny-shape init: conv/GRU param shapes don't depend on image size,
+    # and full-size init would trace the whole model a second time.
+    state = init_state(model, tx, jax.random.PRNGKey(cfg.seed), (48, 64))
     if restore_params is not None:
         state = state.replace(
             params=restore_params["params"],
@@ -79,10 +85,15 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
     step_fn = make_train_step(model, tx, cfg, mesh)
     logger = Logger(cfg.log_freq, lr_fn=schedule_of(cfg.lr, cfg.num_steps),
                     tensorboard_dir=tensorboard_dir)
-    noise_rng = np.random.default_rng(cfg.seed + 1)
     key = jax.random.PRNGKey(cfg.seed)
 
     step = int(state.step)
+    if loader is not None:
+        batches = loader.batches_from_step(step)
+    # Noise RNG keyed on the resume step so a resumed run doesn't replay
+    # the same noise sequence from the beginning.
+    noise_rng = np.random.default_rng(
+        np.random.SeedSequence([cfg.seed + 1, step]))
     t0, steps_t0 = time.time(), step
     for batch in batches:
         if step >= cfg.num_steps:

@@ -91,18 +91,4 @@ def make_train_step(model: RAFT, tx: optax.GradientTransformation,
     )
 
 
-def make_eval_step(model: RAFT, model_cfg: RAFTConfig, iters: int,
-                   mesh: Optional[Mesh] = None) -> Callable:
-    """Jitted test-mode forward: ``(variables, image1, image2) ->
-    (flow_low, flow_up)`` (reference raft.py:141-142)."""
-
-    def eval_fn(variables, image1, image2):
-        return model.apply(variables, image1, image2, iters=iters,
-                           test_mode=True, train=False)
-
-    if mesh is None:
-        return jax.jit(eval_fn)
-    repl = replicated_sharding(mesh)
-    data = batch_sharding(mesh)
-    return jax.jit(eval_fn, in_shardings=(repl, data, data),
-                   out_shardings=(data, data))
+# The jitted test-mode forward lives in raft_tpu.evaluate.make_eval_fn.

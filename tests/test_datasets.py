@@ -259,3 +259,18 @@ def test_sharded_loader_deterministic(sintel_root):
     b1, b2 = first_batch(), first_batch()
     for k in b1:
         np.testing.assert_array_equal(b1[k], b2[k])
+
+
+def test_batches_from_step_resumes_shuffle(sintel_root):
+    ds = MpiSintel(root=sintel_root)  # 4 samples
+    mk = lambda: ShardedLoader(ds, batch_size=2, seed=7, num_workers=1)
+    spe = mk().steps_per_epoch()
+    assert spe == 2
+
+    it = mk().batches()
+    full = [next(it) for _ in range(5)]
+    it2 = mk().batches_from_step(3)
+    resumed = [next(it2) for _ in range(2)]
+    for a, b in zip(full[3:], resumed):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
