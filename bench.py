@@ -25,13 +25,27 @@ BASELINE_PAIRS_PER_SEC_PER_CHIP = 30.0
 
 
 def main():
+    import os
+
     n_dev = jax.device_count()
     mesh = make_mesh(num_data=n_dev, num_spatial=1)
 
     H, W = 368, 496           # chairs crop, train_standard.sh:3
-    per_chip_batch = 6
+    # Batch 12/chip measured ~27% faster per-pair than 6 (amortizes the
+    # fixed per-step work); 24 regresses (HBM pressure).
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", 12))
     B = per_chip_batch * n_dev
-    model_cfg = RAFTConfig.full(compute_dtype="bfloat16")
+    # allpairs is the fast training path on TPU (the pallas/chunked paths
+    # trade speed for O((HW)^2) memory, like the reference's alternate
+    # corr, README.md:75-80).
+    corr_impl = os.environ.get("BENCH_CORR_IMPL", "allpairs")
+    corr_precision = os.environ.get("BENCH_CORR_PRECISION", "highest")
+    remat = os.environ.get("BENCH_REMAT", "1") == "1"
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "full")
+    model_cfg = RAFTConfig.full(compute_dtype="bfloat16",
+                                corr_impl=corr_impl,
+                                corr_precision=corr_precision,
+                                remat=remat, remat_policy=remat_policy)
     cfg = TrainConfig(num_steps=1000, batch_size=B, image_size=(H, W),
                       iters=12)
 

@@ -51,6 +51,9 @@ def parse_args(argv=None):
     p.add_argument("--chairs_split", default="chairs_split.txt")
     p.add_argument("--ckpt_dir", default="checkpoints")
     p.add_argument("--tensorboard_dir", default=None)
+    p.add_argument("--profile_dir", default=None,
+                   help="capture a jax.profiler trace of a few steps "
+                        "into this directory (view with XProf/TB)")
     p.add_argument("--num_workers", type=int, default=4)
     return p.parse_args(argv)
 
@@ -127,7 +130,8 @@ def main(argv=None):
     }
 
     train(model_cfg, cfg, loader=loader, validators=validators or None,
-          restore_params=restore, tensorboard_dir=args.tensorboard_dir)
+          restore_params=restore, tensorboard_dir=args.tensorboard_dir,
+          profile_dir=args.profile_dir)
 
 
 if __name__ == "__main__":

@@ -38,6 +38,11 @@ class RAFTConfig:
     corr_impl: str = "allpairs"
     # Pixels per block for the chunked/pallas on-demand correlation path.
     corr_block_size: int = 256
+    # MXU precision for the correlation matmul + window-sampling einsums:
+    # 'default' (1 bf16 pass), 'high' (bf16x3), 'highest' (fp32 —
+    # measured FASTER than bf16x3 on v5e, and the reference keeps corr
+    # fp32, corr.py:50).
+    corr_precision: str = "highest"
     # bf16 compute for encoders + update block (replaces the reference's
     # torch.cuda.amp autocast, raft.py:11-21,99,110,127); correlation is
     # always fp32 (reference corr.py:50 casts .float()).
@@ -45,6 +50,10 @@ class RAFTConfig:
     # Rematerialize the scan body in backward (memory/flops trade; the
     # reference has no equivalent — torch retains all activations).
     remat: bool = True
+    # Remat policy: 'full' recomputes everything; 'dots' saves matmul
+    # outputs (the correlation lookup einsums — the expensive part of the
+    # recompute) and recomputes only cheap elementwise/conv work.
+    remat_policy: str = "full"
 
     @classmethod
     def full(cls, **kw) -> "RAFTConfig":

@@ -377,6 +377,11 @@ class ShardedLoader:
         """Resume the stream as if ``step`` batches had already been drawn —
         auto-resume continues the shuffle instead of replaying epoch 0."""
         spe = self.steps_per_epoch()
+        if spe == 0:
+            raise ValueError(
+                f"per-host dataset share smaller than "
+                f"batch_size={self.batch_size} with drop_last — no "
+                "batches would ever be produced")
         start_epoch, skip = divmod(step, spe)
         return self.batches(start_epoch, skip_batches=skip)
 

@@ -63,12 +63,14 @@ class RefinementStep(nn.Module):
         coords1 = jax.lax.stop_gradient(coords1)
 
         if cfg.corr_impl == "allpairs":
-            corr = corr_lookup(corr_state, coords1, cfg.corr_radius)
+            corr = corr_lookup(corr_state, coords1, cfg.corr_radius,
+                               cfg.corr_precision)
         elif cfg.corr_impl == "chunked":
             fmap1, f2_pyramid = corr_state
             corr = chunked_corr_lookup(fmap1, f2_pyramid, coords1,
                                        cfg.corr_radius,
-                                       block_size=cfg.corr_block_size)
+                                       block_size=cfg.corr_block_size,
+                                       precision=cfg.corr_precision)
         elif cfg.corr_impl == "pallas":
             from raft_tpu.ops.pallas_corr import pallas_corr_lookup
 
@@ -132,7 +134,8 @@ class RAFT(nn.Module):
         fmap2 = fmaps[B:].astype(jnp.float32)
 
         if cfg.corr_impl == "allpairs":
-            corr_state = build_corr_pyramid(fmap1, fmap2, cfg.corr_levels)
+            corr_state = build_corr_pyramid(fmap1, fmap2, cfg.corr_levels,
+                                            cfg.corr_precision)
         elif cfg.corr_impl in ("chunked", "pallas"):
             corr_state = (fmap1, pool_fmap_pyramid(fmap2, cfg.corr_levels))
         else:
@@ -150,7 +153,12 @@ class RAFT(nn.Module):
 
         step = RefinementStep
         if cfg.remat:
-            step = nn.remat(RefinementStep)
+            if cfg.remat_policy == "dots":
+                step = nn.remat(
+                    RefinementStep,
+                    policy=jax.checkpoint_policies.dots_saveable)
+            else:
+                step = nn.remat(RefinementStep)
         scan = nn.scan(
             step,
             variable_broadcast="params",

@@ -47,13 +47,30 @@ import jax
 import jax.numpy as jnp
 
 
-def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
+def resolve_precision(precision) -> jax.lax.Precision:
+    """'default' | 'high' | 'highest' -> lax.Precision.
+
+    Measured on v5e (bench.py): 'highest' (fp32) is FASTER than 'high'
+    (bf16x3) for the correlation path and is the config default
+    (RAFTConfig.corr_precision); 'default' (one bf16 pass) bought <2%
+    end-to-end, so there is no reason to give up fp32 correlation
+    (which the reference also keeps, corr.py:50).
+    """
+    if isinstance(precision, jax.lax.Precision):
+        return precision
+    return {"default": jax.lax.Precision.DEFAULT,
+            "high": jax.lax.Precision.HIGH,
+            "highest": jax.lax.Precision.HIGHEST}[precision]
+
+
+def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array,
+                          precision="highest") -> jax.Array:
     """``(B, H, W, C) x (B, H, W, C) -> (B, H1*W1, H2, W2)`` fp32 volume."""
     B, H, W, C = fmap1.shape
     f1 = fmap1.reshape(B, H * W, C).astype(jnp.float32)
     f2 = fmap2.reshape(B, H * W, C).astype(jnp.float32)
     corr = jnp.einsum("bnc,bmc->bnm", f1, f2,
-                      precision=jax.lax.Precision.HIGHEST,
+                      precision=resolve_precision(precision),
                       preferred_element_type=jnp.float32)
     corr = corr / jnp.sqrt(jnp.float32(C))
     return corr.reshape(B, H * W, H, W)
@@ -70,9 +87,10 @@ def _avg_pool_2x2(x: jax.Array) -> jax.Array:
 
 
 def build_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array,
-                       num_levels: int = 4) -> List[jax.Array]:
+                       num_levels: int = 4,
+                       precision="highest") -> List[jax.Array]:
     """Materialized pyramid: level l is ``(B, H1*W1, H/2^l, W/2^l)``."""
-    corr = all_pairs_correlation(fmap1, fmap2)
+    corr = all_pairs_correlation(fmap1, fmap2, precision)
     pyramid = [corr]
     for _ in range(num_levels - 1):
         corr = _avg_pool_2x2(corr)
@@ -97,7 +115,7 @@ def _interp_weights_1d(c: jax.Array, n: int, radius: int) -> jax.Array:
 
 
 def _sample_windows(corr: jax.Array, coords: jax.Array,
-                    radius: int) -> jax.Array:
+                    radius: int, precision="highest") -> jax.Array:
     """Bilinear window sampling as two batched mat-muls (MXU-friendly).
 
     Bilinear interpolation is linear in the image, so the ``(2r+1)^2``
@@ -119,16 +137,17 @@ def _sample_windows(corr: jax.Array, coords: jax.Array,
     wx = _interp_weights_1d(c[..., 0], W, radius)     # (B, N, K, W)
     wy = _interp_weights_1d(c[..., 1], H, radius)     # (B, N, K, H)
     # a(b,n,j,x) = sum_y wy(b,n,j,y) corr(b,n,y,x)
+    prec = resolve_precision(precision)
     a = jnp.einsum("bnjy,bnyx->bnjx", wy, corr.astype(jnp.float32),
-                   preferred_element_type=jnp.float32)
+                   precision=prec, preferred_element_type=jnp.float32)
     # tap(b,n,i,j) = sum_x wx(b,n,i,x) a(b,n,j,x)
     taps = jnp.einsum("bnix,bnjx->bnij", wx, a,
-                      preferred_element_type=jnp.float32)
+                      precision=prec, preferred_element_type=jnp.float32)
     return taps.reshape(B, N, K * K)
 
 
 def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
-                radius: int) -> jax.Array:
+                radius: int, precision="highest") -> jax.Array:
     """Sample the materialized pyramid (reference ``CorrBlock.__call__``).
 
     Args:
@@ -143,7 +162,8 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
     c = coords.reshape(B, H1 * W1, 2).astype(jnp.float32)
     outs = []
     for lvl, corr in enumerate(pyramid):
-        outs.append(_sample_windows(corr, c / (2.0 ** lvl), radius))
+        outs.append(_sample_windows(corr, c / (2.0 ** lvl), radius,
+                                    precision))
     out = jnp.concatenate(outs, axis=-1)
     return out.reshape(B, H1, W1, -1)
 
@@ -164,7 +184,8 @@ def pool_fmap_pyramid(fmap2: jax.Array, num_levels: int) -> List[jax.Array]:
 
 def chunked_corr_lookup(fmap1: jax.Array, fmap2_pyramid: Sequence[jax.Array],
                         coords: jax.Array, radius: int,
-                        block_size: int = 256) -> jax.Array:
+                        block_size: int = 256,
+                        precision="highest") -> jax.Array:
     """On-demand blockwise correlation lookup (memory-efficient path).
 
     Never materializes the ``O((HW)^2)`` volume: for each block of query
@@ -208,11 +229,11 @@ def chunked_corr_lookup(fmap1: jax.Array, fmap2_pyramid: Sequence[jax.Array],
         for lvl, f2 in enumerate(f2_flat):
             Bf, Hl, Wl, _ = f2.shape
             rows = jnp.einsum("bnc,bhwc->bnhw", f1_b, f2,
-                              precision=jax.lax.Precision.HIGHEST,
+                              precision=resolve_precision(precision),
                               preferred_element_type=jnp.float32) * scale
             outs.append(_sample_windows(
                 rows.reshape(B, block_size, Hl, Wl),
-                c_b / (2.0 ** lvl), radius))
+                c_b / (2.0 ** lvl), radius, precision))
         return carry, jnp.concatenate(outs, axis=-1)
 
     _, out = jax.lax.scan(

@@ -50,7 +50,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _tap_weight(c: jax.Array, offset: float, pos: jax.Array) -> jax.Array:
+# Image rows per inner mat-mul tile; statically unrolled inside, fori_loop
+# across tiles (full unroll over Hl explodes Mosaic compile time, per-row
+# mat-muls are latency-bound).
+_Y_TILE = 8
+
+
+def _tap_weight(c: jax.Array, offset, pos) -> jax.Array:
     """Bilinear weight ``max(0, 1 - |c + offset - pos|)`` (zeros padding
     falls out as all-zero weights for out-of-range taps)."""
     return jnp.maximum(0.0, 1.0 - jnp.abs(c + offset - pos))
@@ -71,19 +77,42 @@ def _fwd_kernel(f1_ref, c_ref, f2_ref, out_ref, *, hl, wl, k, inv_scale,
     posx = jax.lax.broadcasted_iota(jnp.int32, (wl, bq), 0) \
         .astype(jnp.float32)            # (Wl, BQ)
 
-    def body(y, acc):
-        f2_y = f2_ref[0, y]             # (Wl, C)
-        rows_y = jax.lax.dot_general(
-            f2_y, f1, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * inv_scale   # (Wl, BQ)
-        yf = y.astype(jnp.float32)
-        # acc(j, x, q) += wy_j(q) * rows_y(x, q)
-        return acc + jnp.stack(
-            [(_tap_weight(cy, j - r, yf))[None, :] * rows_y
-             for j in range(k)])
+    # y-tiled row computation: one (T*Wl, C) x (C, BQ) mat-mul per tile of
+    # T image rows (big MXU work), with the K vertical-tap accumulations
+    # statically unrolled inside the tile.  A tile size of 8 keeps the
+    # Mosaic unroll small (full static unroll over hl explodes compile
+    # time; per-row matmuls are latency-bound).
+    t_y = min(_Y_TILE, hl)
+    n_tiles = hl // t_y
+    C = f1.shape[-1]
 
-    a = jax.lax.fori_loop(
-        0, hl, body, jnp.zeros((k, wl, bq), jnp.float32))   # (K_j, Wl, BQ)
+    def tile_body(t, acc):
+        f2_t = f2_ref[0, pl.ds(t * t_y, t_y)].reshape(t_y * wl, C)
+        rows = jax.lax.dot_general(
+            f2_t, f1, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * inv_scale  # (T*Wl, BQ)
+        rows3 = rows.reshape(t_y, wl, bq)
+        y0 = (t * t_y).astype(jnp.float32)
+        for yi in range(t_y):
+            for j in range(k):
+                acc[j] += _tap_weight(cy, j - r - yi,
+                                      y0)[None, :] * rows3[yi]
+        return acc
+
+    acc = jax.lax.fori_loop(
+        0, n_tiles, tile_body,
+        [jnp.zeros((wl, bq), jnp.float32) for _ in range(k)])
+    if hl % t_y:  # static remainder rows
+        rem = hl - hl % t_y
+        f2_t = f2_ref[0, rem:].reshape((hl - rem) * wl, C)
+        rows3 = (jax.lax.dot_general(
+            f2_t, f1, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+            * inv_scale).reshape(hl - rem, wl, bq)
+        for yi in range(hl - rem):
+            for j in range(k):
+                acc[j] += _tap_weight(cy, j - r,
+                                      float(rem + yi))[None, :] * rows3[yi]
 
     # Contract x with a ones-row mat-mul: Mosaic can't emit sublane
     # reductions with 1-D outputs, but (1, Wl) @ (Wl, BQ) is plain MXU.
@@ -92,7 +121,7 @@ def _fwd_kernel(f1_ref, c_ref, f2_ref, out_ref, *, hl, wl, k, inv_scale,
         wx_i = _tap_weight(cx[None, :], float(i - r), posx)  # (Wl, BQ)
         for j in range(k):
             out_ref[0, 0, i, j:j + 1, :] = jax.lax.dot_general(
-                ones_row, wx_i * a[j], (((1,), (0,)), ((), ())),
+                ones_row, wx_i * acc[j], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)          # (1, BQ)
 
 
@@ -121,28 +150,49 @@ def _bwd_kernel(f1_ref, c_ref, f2_ref, g_ref, df1_ref, df2_ref, *,
         for tj in range(k)
     ]                                    # K_j x (Wl, BQ)
 
+    # df2 accumulates over query blocks (TPU grid runs sequentially).
     @pl.when(i == 0)
     def _():
         df2_ref[0] = jnp.zeros_like(df2_ref[0])
 
-    def body(y, df1):
-        yf = y.astype(jnp.float32)
-        drows_y = sum(
-            (_tap_weight(cy, tj - r, yf))[None, :] * b[tj]
-            for tj in range(k)) * inv_scale              # (Wl, BQ)
-        f2_y = f2_ref[0, y]                              # (Wl, C)
-        # df1(q, c) += sum_x drows_y(x, q) f2_y(x, c)
+    # y-tiled: assemble drows for T image rows (static unroll inside the
+    # tile), then two (T*Wl)-sized mat-muls per tile.
+    C = f1.shape[-1]
+    t_y = min(_Y_TILE, hl)
+    n_tiles = hl // t_y
+
+    def _tile_grads(y0f, yis, f2_t, df1):
+        drows = jnp.concatenate([
+            sum((_tap_weight(cy, tj - r - yi, y0f))[None, :] * b[tj]
+                for tj in range(k))
+            for yi in yis
+        ], axis=0) * inv_scale                           # (T*Wl, BQ)
+        # df1(q, c) += sum_yx drows(yx, q) f2_t(yx, c)
         df1 = df1 + jax.lax.dot_general(
-            drows_y, f2_y, (((0,), (0,)), ((), ())),
+            drows, f2_t, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (BQ, C)
-        # df2(y, x, c) += sum_q drows_y(x, q) f1(q, c)
-        df2_ref[0, y] += jax.lax.dot_general(
-            drows_y, f1, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # (Wl, C)
+        # df2(yx, c) += sum_q drows(yx, q) f1(q, c)
+        df2_t = jax.lax.dot_general(
+            drows, f1, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (T*Wl, C)
+        return df1, df2_t
+
+    def tile_body(t, df1):
+        f2_t = f2_ref[0, pl.ds(t * t_y, t_y)].reshape(t_y * wl, C)
+        df1, df2_t = _tile_grads((t * t_y).astype(jnp.float32),
+                                 range(t_y), f2_t, df1)
+        df2_ref[0, pl.ds(t * t_y, t_y)] += df2_t.reshape(t_y, wl, C)
         return df1
 
-    df1_ref[0] = jax.lax.fori_loop(
-        0, hl, body, jnp.zeros((bq, f1.shape[-1]), jnp.float32))
+    df1 = jax.lax.fori_loop(0, n_tiles, tile_body,
+                            jnp.zeros((bq, C), jnp.float32))
+    if hl % t_y:  # static remainder rows
+        rem = hl - hl % t_y
+        f2_t = f2_ref[0, rem:].reshape((hl - rem) * wl, C)
+        df1, df2_t = _tile_grads(jnp.float32(rem), range(hl - rem), f2_t,
+                                 df1)
+        df2_ref[0, rem:] += df2_t.reshape(hl - rem, wl, C)
+    df1_ref[0] = df1
 
 
 def _pad_queries(f1, coords, block_q):

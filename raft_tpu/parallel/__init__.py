@@ -5,4 +5,5 @@ from raft_tpu.parallel.mesh import (  # noqa: F401
     batch_sharding,
     replicated_sharding,
     shard_batch,
+    spatial_batch_sharding,
 )

@@ -45,19 +45,32 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def spatial_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch over ``data`` AND image height over ``spatial`` — the
+    long-context analog for RAFT (SURVEY.md §5): activations, the
+    correlation pyramid's query rows, and the refinement state are split
+    across chips by image rows, and GSPMD inserts the conv halo exchanges
+    and cross-shard reductions (instance-norm statistics, all-pairs
+    fmap2 gathers) automatically.  Use for inputs too large for one
+    chip's HBM (720p+ all-pairs volumes)."""
+    return NamedSharding(mesh, P(DATA_AXIS, SPATIAL_AXIS))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh):
-    """Place a host batch onto the mesh, batch-dim sharded over ``data``.
+def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh,
+                spatial: bool = False):
+    """Place a host batch onto the mesh, batch-dim sharded over ``data``
+    (and, with ``spatial=True``, image height over ``spatial``).
 
     Single-host: a plain sharded device_put.  Multi-host: each process
     passes its *local* batch (its stride of the global shuffle from
     ``ShardedLoader``) and the global array is assembled from the
     process-local shards — the global batch is ``num_hosts * local_batch``.
     """
-    sh = batch_sharding(mesh)
+    sh = spatial_batch_sharding(mesh) if spatial else batch_sharding(mesh)
     if jax.process_count() == 1:
         return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
     return jax.tree_util.tree_map(

@@ -28,6 +28,7 @@ from raft_tpu.train.loss import sequence_loss  # noqa: F401 (re-export)
 from raft_tpu.train.optim import make_optimizer, schedule_of
 from raft_tpu.train.state import TrainState
 from raft_tpu.train.step import init_state, make_train_step
+from raft_tpu.utils.profiling import StepProfiler, annotate_step
 
 
 def add_image_noise(rng: np.random.Generator, batch: Dict) -> Dict:
@@ -48,6 +49,7 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
           validators: Optional[Dict[str, Callable]] = None,
           restore_params=None,
           tensorboard_dir: Optional[str] = None,
+          profile_dir: Optional[str] = None,
           mesh=None) -> TrainState:
     """Run the full training loop.
 
@@ -94,13 +96,17 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
     # the same noise sequence from the beginning.
     noise_rng = np.random.default_rng(
         np.random.SeedSequence([cfg.seed + 1, step]))
+    profiler = StepProfiler(profile_dir)
     t0, steps_t0 = time.time(), step
     for batch in batches:
         if step >= cfg.num_steps:
             break
         if cfg.add_noise:
             batch = add_image_noise(noise_rng, batch)
-        state, metrics = step_fn(state, shard_batch(batch, mesh), key)
+        profiler.maybe_start(step)
+        with annotate_step(step):
+            state, metrics = step_fn(state, shard_batch(batch, mesh), key)
+        profiler.maybe_stop(step)
         step += 1
         logger.push(step - 1, metrics)
 
@@ -124,5 +130,6 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
         mgr.save(int(state.step), state, force=True)
     mgr.wait()
     mgr.close()
+    profiler.close()
     logger.close()
     return state

@@ -21,7 +21,8 @@ from jax.sharding import Mesh
 
 from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.models.raft import RAFT
-from raft_tpu.parallel.mesh import batch_sharding, replicated_sharding
+from raft_tpu.parallel.mesh import (batch_sharding, replicated_sharding,
+                                    spatial_batch_sharding)
 from raft_tpu.train.loss import sequence_loss
 from raft_tpu.train.state import TrainState
 
@@ -43,11 +44,15 @@ def init_state(model: RAFT, tx: optax.GradientTransformation,
 
 def make_train_step(model: RAFT, tx: optax.GradientTransformation,
                     cfg: TrainConfig, mesh: Optional[Mesh] = None,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True,
+                    shard_spatial: bool = False) -> Callable:
     """Build ``step_fn(state, batch, rng) -> (state, metrics)``.
 
     ``batch``: dict of ``image1/image2 (B,H,W,3)``, ``flow (B,H,W,2)``,
     ``valid (B,H,W)`` — globally batch-sharded when a mesh is given.
+    ``shard_spatial`` additionally splits image height over the mesh's
+    ``spatial`` axis (activation/corr-volume sharding for large inputs —
+    GSPMD inserts the halo exchanges and gathers).
     ``freeze_bn`` is static per-stage (reference train.py:147-148).
     """
 
@@ -82,7 +87,8 @@ def make_train_step(model: RAFT, tx: optax.GradientTransformation,
         return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
     repl = replicated_sharding(mesh)
-    data = batch_sharding(mesh)
+    data = spatial_batch_sharding(mesh) if shard_spatial \
+        else batch_sharding(mesh)
     return jax.jit(
         step_fn,
         in_shardings=(repl, data, repl),

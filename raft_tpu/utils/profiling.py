@@ -1,0 +1,63 @@
+"""Profiling hooks (SURVEY.md §5: the reference has no tracing/profiling;
+the TPU plan is ``jax.profiler`` traces viewable in XProf/TensorBoard).
+
+``trace_steps`` wraps a window of training steps in a profiler trace:
+the driver calls ``maybe_start``/``maybe_stop`` around each step, and the
+captured trace lands in ``<dir>/plugins/profile/...``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class StepProfiler:
+    """Capture a ``jax.profiler`` trace for steps [start, stop).
+
+    Inactive (no overhead beyond two int compares) when ``trace_dir`` is
+    None.  The first few steps are skipped by default so compilation does
+    not pollute the trace.
+    """
+
+    trace_dir: Optional[str] = None
+    start_step: int = 10          # relative to the first observed step
+    num_steps: int = 5
+    _first_step: Optional[int] = None
+    _running: bool = False
+    _done: bool = False
+
+    def maybe_start(self, step: int) -> None:
+        if self.trace_dir is None or self._running or self._done:
+            return
+        # Anchor to the first step this run actually executes, so a
+        # checkpoint-resumed run still skips its compile steps.
+        if self._first_step is None:
+            self._first_step = step
+        if step - self._first_step < self.start_step:
+            return
+        jax.profiler.start_trace(self.trace_dir)
+        self._running = True
+
+    def maybe_stop(self, step: int) -> None:
+        if not self._running:
+            return
+        if step - self._first_step + 1 >= self.start_step + self.num_steps:
+            jax.profiler.stop_trace()
+            self._running = False
+            self._done = True
+            print(f"profiler trace written to {self.trace_dir}",
+                  flush=True)
+
+    def close(self) -> None:
+        if self._running:
+            jax.profiler.stop_trace()
+            self._running = False
+
+
+def annotate_step(step: int):
+    """Named step annotation shown on the XProf timeline."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)

@@ -1,0 +1,58 @@
+"""Spatial (image-height) activation sharding over the 2-D mesh — the
+long-context analog (SURVEY.md §5): GSPMD splits activations and the
+correlation volume's query rows across chips and inserts conv halo
+exchanges automatically.  Verified on the 8-virtual-device CPU mesh
+against the purely data-parallel result."""
+
+import jax
+import numpy as np
+import pytest
+
+from raft_tpu.config import RAFTConfig, TrainConfig
+from raft_tpu.models.raft import RAFT
+from raft_tpu.parallel.mesh import make_mesh, shard_batch
+from raft_tpu.train.optim import make_optimizer
+from raft_tpu.train.step import init_state, make_train_step
+
+H, W, B = 48, 64, 4
+
+
+def _batch(rng):
+    return {
+        "image1": rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32),
+        "image2": rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32),
+        "flow": rng.standard_normal((B, H, W, 2)).astype(np.float32),
+        "valid": np.ones((B, H, W), np.float32),
+    }
+
+
+@pytest.mark.parametrize("corr_impl", ["allpairs"])
+def test_spatial_sharded_step_matches_dp(corr_impl):
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    model_cfg = RAFTConfig.small_model(corr_impl=corr_impl)
+    cfg = TrainConfig(num_steps=10, batch_size=B, image_size=(H, W),
+                      iters=2)
+    model = RAFT(model_cfg)
+    tx = make_optimizer(cfg.lr, cfg.num_steps, cfg.wdecay, cfg.epsilon,
+                        cfg.clip)
+    rng = np.random.default_rng(0)
+    batch = _batch(rng)
+    key = jax.random.PRNGKey(1)
+
+    mesh_dp = make_mesh(num_data=4, num_spatial=1,
+                        devices=jax.devices()[:4])
+    state = init_state(model, tx, jax.random.PRNGKey(0), (H, W))
+    step_dp = make_train_step(model, tx, cfg, mesh_dp, donate=False)
+    _, m_dp = step_dp(state, shard_batch(batch, mesh_dp), key)
+
+    mesh_sp = make_mesh(num_data=4, num_spatial=2)
+    step_sp = make_train_step(model, tx, cfg, mesh_sp, donate=False,
+                              shard_spatial=True)
+    _, m_sp = step_sp(state, shard_batch(batch, mesh_sp, spatial=True),
+                      key)
+
+    np.testing.assert_allclose(float(m_dp["loss"]), float(m_sp["loss"]),
+                               rtol=2e-4)
+    np.testing.assert_allclose(float(m_dp["epe"]), float(m_sp["epe"]),
+                               rtol=2e-4)
