@@ -42,10 +42,12 @@ def main():
     corr_precision = os.environ.get("BENCH_CORR_PRECISION", "highest")
     remat = os.environ.get("BENCH_REMAT", "1") == "1"
     remat_policy = os.environ.get("BENCH_REMAT_POLICY", "full")
+    scan_unroll = int(os.environ.get("BENCH_SCAN_UNROLL", "1"))
     model_cfg = RAFTConfig.full(compute_dtype="bfloat16",
                                 corr_impl=corr_impl,
                                 corr_precision=corr_precision,
-                                remat=remat, remat_policy=remat_policy)
+                                remat=remat, remat_policy=remat_policy,
+                                scan_unroll=scan_unroll)
     cfg = TrainConfig(num_steps=1000, batch_size=B, image_size=(H, W),
                       iters=12)
 

@@ -44,8 +44,9 @@ class RAFTConfig:
     # fp32, corr.py:50).
     corr_precision: str = "highest"
     # bf16 compute for encoders + update block (replaces the reference's
-    # torch.cuda.amp autocast, raft.py:11-21,99,110,127); correlation is
-    # always fp32 (reference corr.py:50 casts .float()).
+    # torch.cuda.amp autocast, raft.py:11-21,99,110,127); correlation
+    # stays fp32 at the default corr_precision='highest' (reference
+    # corr.py:50 casts .float()) — see corr_precision above to relax it.
     compute_dtype: str = "float32"
     # Rematerialize the scan body in backward (memory/flops trade; the
     # reference has no equivalent — torch retains all activations).
@@ -54,6 +55,9 @@ class RAFTConfig:
     # outputs (the correlation lookup einsums — the expensive part of the
     # recompute) and recomputes only cheap elementwise/conv work.
     remat_policy: str = "full"
+    # Refinement-scan unroll factor (lax.scan unroll): trades compile
+    # time/code size for less per-iteration loop overhead.
+    scan_unroll: int = 1
 
     @classmethod
     def full(cls, **kw) -> "RAFTConfig":

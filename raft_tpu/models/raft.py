@@ -157,8 +157,12 @@ class RAFT(nn.Module):
                 step = nn.remat(
                     RefinementStep,
                     policy=jax.checkpoint_policies.dots_saveable)
-            else:
+            elif cfg.remat_policy == "full":
                 step = nn.remat(RefinementStep)
+            else:
+                raise ValueError(
+                    f"unknown remat_policy: {cfg.remat_policy!r} "
+                    "(expected 'full' or 'dots')")
         scan = nn.scan(
             step,
             variable_broadcast="params",
@@ -166,6 +170,7 @@ class RAFT(nn.Module):
             in_axes=nn.broadcast,
             out_axes=0,
             length=iters,
+            unroll=cfg.scan_unroll,
         )(cfg, name="refine")
 
         (net, coords1), flow_ups = scan(

@@ -86,18 +86,23 @@ def _fwd_kernel(f1_ref, c_ref, f2_ref, out_ref, *, hl, wl, k, inv_scale,
     n_tiles = hl // t_y
     C = f1.shape[-1]
 
-    def tile_body(t, acc):
-        f2_t = f2_ref[0, pl.ds(t * t_y, t_y)].reshape(t_y * wl, C)
-        rows = jax.lax.dot_general(
+    def _tile_taps(y0f, yis, f2_t, acc):
+        """Accumulate the vertical taps for ``len(yis)`` image rows whose
+        flat features are ``f2_t`` (rows start at traced/static ``y0f``)."""
+        rows3 = (jax.lax.dot_general(
             f2_t, f1, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * inv_scale  # (T*Wl, BQ)
-        rows3 = rows.reshape(t_y, wl, bq)
-        y0 = (t * t_y).astype(jnp.float32)
-        for yi in range(t_y):
+            preferred_element_type=jnp.float32)
+            * inv_scale).reshape(len(yis), wl, bq)
+        for yi in yis:
             for j in range(k):
                 acc[j] += _tap_weight(cy, j - r - yi,
-                                      y0)[None, :] * rows3[yi]
+                                      y0f)[None, :] * rows3[yi]
         return acc
+
+    def tile_body(t, acc):
+        f2_t = f2_ref[0, pl.ds(t * t_y, t_y)].reshape(t_y * wl, C)
+        return _tile_taps((t * t_y).astype(jnp.float32), range(t_y), f2_t,
+                          acc)
 
     acc = jax.lax.fori_loop(
         0, n_tiles, tile_body,
@@ -105,14 +110,7 @@ def _fwd_kernel(f1_ref, c_ref, f2_ref, out_ref, *, hl, wl, k, inv_scale,
     if hl % t_y:  # static remainder rows
         rem = hl - hl % t_y
         f2_t = f2_ref[0, rem:].reshape((hl - rem) * wl, C)
-        rows3 = (jax.lax.dot_general(
-            f2_t, f1, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-            * inv_scale).reshape(hl - rem, wl, bq)
-        for yi in range(hl - rem):
-            for j in range(k):
-                acc[j] += _tap_weight(cy, j - r,
-                                      float(rem + yi))[None, :] * rows3[yi]
+        acc = _tile_taps(jnp.float32(rem), range(hl - rem), f2_t, acc)
 
     # Contract x with a ones-row mat-mul: Mosaic can't emit sublane
     # reductions with 1-D outputs, but (1, Wl) @ (Wl, BQ) is plain MXU.

@@ -106,7 +106,7 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
         profiler.maybe_start(step)
         with annotate_step(step):
             state, metrics = step_fn(state, shard_batch(batch, mesh), key)
-        profiler.maybe_stop(step)
+        profiler.maybe_stop(step, sync_on=metrics.get("loss"))
         step += 1
         logger.push(step - 1, metrics)
 

@@ -42,10 +42,19 @@ class StepProfiler:
         jax.profiler.start_trace(self.trace_dir)
         self._running = True
 
-    def maybe_stop(self, step: int) -> None:
+    def maybe_stop(self, step: int, sync_on=None) -> None:
+        """``sync_on``: a device array from the traced step (e.g. the loss).
+        The step loop dispatches asynchronously, so without a hard sync the
+        trace would stop before the device executed the traced steps (and
+        ``block_until_ready`` alone is unreliable on the tunneled
+        platform — force a host transfer)."""
         if not self._running:
             return
         if step - self._first_step + 1 >= self.start_step + self.num_steps:
+            if sync_on is not None:
+                import numpy as np
+
+                np.asarray(jax.device_get(sync_on))
             jax.profiler.stop_trace()
             self._running = False
             self._done = True

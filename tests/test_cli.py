@@ -139,3 +139,31 @@ def test_lk_compare_cli(tmp_path):
     assert out.exists()
     side = np.asarray(Image.open(out))
     assert side.shape == (H, 2 * W, 3)
+
+
+def test_evaluate_cli_alternate_corr(chairs_tree, tmp_path):
+    """--alternate_corr exercises the chunked on-demand correlation path
+    end-to-end (reference evaluate.py --alternate_corr)."""
+    import jax
+
+    from raft_tpu.cli import evaluate as eval_cli
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.train.checkpoint import save_variables
+
+    cfg = RAFTConfig.small_model()
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img = jax.numpy.zeros((1, 64, 96, 3))
+    variables = model.init({"params": rng, "dropout": rng}, img, img,
+                           iters=1)
+    ckpt = str(tmp_path / "ckpt_alt")
+    save_variables(ckpt, {"params": variables["params"],
+                          "batch_stats":
+                          dict(variables.get("batch_stats", {}))})
+    eval_cli.main([
+        "--model", ckpt, "--dataset", "chairs", "--small",
+        "--precision", "fp32", "--iters", "2", "--alternate_corr",
+        "--data_root", str(chairs_tree / "datasets"),
+        "--chairs_split", str(chairs_tree / "chairs_split.txt"),
+    ])

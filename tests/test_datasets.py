@@ -13,7 +13,8 @@ from raft_tpu.data import frame_utils
 from raft_tpu.data.augment import (ColorJitter, FlowAugmentor,
                                    SparseFlowAugmentor,
                                    resize_sparse_flow_map)
-from raft_tpu.data.datasets import (ConcatFlowDataset, FlyingChairs, KITTI,
+from raft_tpu.data.datasets import (ConcatFlowDataset, FlyingChairs,
+                                    FlyingThings3D, HD1K, KITTI,
                                     MpiSintel, ShardedLoader, fetch_dataset)
 
 H, W = 96, 128
@@ -274,3 +275,67 @@ def test_batches_from_step_resumes_shuffle(sintel_root):
     for a, b in zip(full[3:], resumed):
         for k in a:
             np.testing.assert_array_equal(a[k], b[k])
+
+
+@pytest.fixture
+def things_root(tmp_path):
+    rng = np.random.default_rng(3)
+    scene = tmp_path / "FlyingThings3D"
+    img_dir = scene / "frames_cleanpass/TRAIN/A/0000/left"
+    img_dir.mkdir(parents=True)
+    for d in ("into_future", "into_past"):
+        (scene / "optical_flow/TRAIN/A/0000" / d / "left").mkdir(
+            parents=True)
+    for i in range(3):
+        _write_img(img_dir / f"{i:04d}.png", rng)
+        for d in ("into_future", "into_past"):
+            flow = rng.normal(size=(H, W, 2)).astype(np.float32)
+            path = scene / "optical_flow/TRAIN/A/0000" / d / "left" / \
+                f"{i:04d}.pfm"
+            # 3-channel little-endian PFM (flow in the first two channels)
+            arr3 = np.concatenate(
+                [flow, np.zeros((H, W, 1), np.float32)], axis=-1)
+            with open(path, "wb") as f:
+                f.write(b"PF\n")
+                f.write(f"{W} {H}\n".encode())
+                f.write(b"-1.0\n")
+                f.write(arr3[::-1].astype("<f4").tobytes())
+    return str(scene)
+
+
+def test_flyingthings_directions(things_root):
+    ds = FlyingThings3D(root=things_root)
+    # 3 frames -> 2 future pairs + 2 past pairs (order swapped)
+    assert len(ds) == 4
+    s = ds.load(0)
+    assert s["image1"].shape == (H, W, 3)
+    assert s["flow"].shape == (H, W, 2)
+    # into_past entries swap the image order relative to into_future
+    futures = ds.image_list[:2]
+    pasts = ds.image_list[2:]
+    assert futures[0][0] == pasts[0][1]
+
+
+@pytest.fixture
+def hd1k_root(tmp_path):
+    rng = np.random.default_rng(4)
+    img_dir = tmp_path / "HD1k/hd1k_input/image_2"
+    flow_dir = tmp_path / "HD1k/hd1k_flow_gt/flow_occ"
+    img_dir.mkdir(parents=True)
+    flow_dir.mkdir(parents=True)
+    for seq in range(2):
+        for i in range(3):
+            _write_img(img_dir / f"{seq:06d}_{i:04d}.png", rng)
+            frame_utils.write_flow_kitti(
+                str(flow_dir / f"{seq:06d}_{i:04d}.png"),
+                rng.normal(scale=3, size=(H, W, 2)).astype(np.float32))
+    return str(tmp_path / "HD1k")
+
+
+def test_hd1k_sequence_scan(hd1k_root):
+    ds = HD1K(root=hd1k_root)
+    # per sequence: len(flows)-1 = 2 pairs, 2 sequences -> 4
+    assert len(ds) == 4
+    s = ds.load(0)
+    assert s["flow"].shape == (H, W, 2)
+    assert s["valid"].shape == (H, W)
