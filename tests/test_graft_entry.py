@@ -1,0 +1,33 @@
+"""Driver-hook regression tests: the round driver compile-checks
+``entry()`` single-chip and runs ``dryrun_multichip`` on virtual CPU
+devices — if these break, the whole round's validation fails."""
+
+import numpy as np
+
+
+def test_entry_shapes():
+    import importlib.util
+    import jax
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", "__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    fn, args = mod.entry()
+    low, up = jax.jit(fn)(*args)
+    assert low.shape == (1, 12, 16, 2)
+    assert up.shape == (1, 96, 128, 2)
+    assert np.isfinite(np.asarray(up)).all()
+
+
+def test_dryrun_multichip_8():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", "__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # conftest already forces the cpu platform with 8 virtual devices;
+    # the dryrun's own env forcing is a no-op here.
+    mod.dryrun_multichip(8)
