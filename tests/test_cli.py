@@ -167,3 +167,25 @@ def test_evaluate_cli_alternate_corr(chairs_tree, tmp_path):
         "--data_root", str(chairs_tree / "datasets"),
         "--chairs_split", str(chairs_tree / "chairs_split.txt"),
     ])
+
+
+def test_train_cli_curriculum_restore(chairs_tree, monkeypatch):
+    """Stage-to-stage weight seeding via --restore_ckpt (the curriculum's
+    chaining mechanism, reference train_standard.sh + strict=False load)."""
+    from raft_tpu.cli import train as train_cli
+
+    monkeypatch.chdir(chairs_tree)
+    common = [
+        "--stage", "chairs", "--small", "--batch_size", "8",
+        "--image_size", "64", "96", "--iters", "2", "--precision", "fp32",
+        "--data_root", str(chairs_tree / "datasets"),
+        "--chairs_split", str(chairs_tree / "chairs_split.txt"),
+        "--ckpt_dir", str(chairs_tree / "ckpts"), "--num_workers", "2",
+    ]
+    train_cli.main(["--name", "stage-a", "--num_steps", "2"] + common)
+    train_cli.main(["--name", "stage-b", "--num_steps", "1",
+                    "--restore_ckpt", str(chairs_tree / "ckpts/stage-a")]
+                   + common)
+    run_dir = chairs_tree / "ckpts" / "stage-b"
+    steps = [d for d in os.listdir(run_dir) if d.isdigit()]
+    assert steps, os.listdir(run_dir)
