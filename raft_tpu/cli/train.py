@@ -55,6 +55,10 @@ def parse_args(argv=None):
                    help="capture a jax.profiler trace of a few steps "
                         "into this directory (view with XProf/TB)")
     p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host pod run: call "
+                        "jax.distributed.initialize() (auto-detects the "
+                        "coordinator on TPU pods) before touching devices")
     return p.parse_args(argv)
 
 
@@ -62,6 +66,12 @@ def main(argv=None):
     args = parse_args(argv)
 
     import jax
+
+    if args.distributed:
+        # Must run before any backend initialization; every host then sees
+        # the same global device mesh and feeds its own batch stride
+        # (ShardedLoader host_id below).
+        jax.distributed.initialize()
 
     from raft_tpu import evaluate
     from raft_tpu.config import RAFTConfig, TrainConfig
