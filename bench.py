@@ -43,7 +43,8 @@ def main():
     remat = os.environ.get("BENCH_REMAT", "1") == "1"
     remat_policy = os.environ.get("BENCH_REMAT_POLICY", "full")
     scan_unroll = int(os.environ.get("BENCH_SCAN_UNROLL", "1"))
-    model_cfg = RAFTConfig.full(compute_dtype="bfloat16",
+    compute_dtype = os.environ.get("BENCH_COMPUTE_DTYPE", "bfloat16")
+    model_cfg = RAFTConfig.full(compute_dtype=compute_dtype,
                                 corr_impl=corr_impl,
                                 corr_precision=corr_precision,
                                 remat=remat, remat_policy=remat_policy,

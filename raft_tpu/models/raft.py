@@ -57,8 +57,8 @@ class RefinementStep(nn.Module):
     def __call__(self, carry, inputs):
         cfg = self.config
         dt = cfg.dtype
-        net, coords1 = carry
-        inp, coords0, corr_state = inputs
+        net, coords1 = carry[0], carry[1]
+        inp, coords0, corr_state, loss_targets = inputs
 
         coords1 = jax.lax.stop_gradient(coords1)
 
@@ -97,7 +97,19 @@ class RefinementStep(nn.Module):
         else:
             flow_up = convex_upsample(new_flow, mask.astype(jnp.float32))
 
-        return (net, coords1), flow_up
+        if loss_targets is None:
+            return (net, coords1), flow_up
+
+        # Fused in-scan loss: reduce each iteration's upsampled flow to a
+        # scalar immediately instead of stacking (iters, B, H, W, 2) to
+        # HBM (the reference keeps a Python list of full-res flows,
+        # train.py:47-60).  Numerics identical to
+        # raft_tpu.train.loss.sequence_loss; the last flow rides the
+        # carry so metrics are computed once, outside the scan.
+        flow_gt, vmask = loss_targets
+        abs_err = jnp.abs(flow_up - flow_gt)
+        per_iter_loss = jnp.mean(vmask[..., None] * abs_err)
+        return (net, coords1, flow_up), per_iter_loss
 
 
 class RAFT(nn.Module):
@@ -109,7 +121,13 @@ class RAFT(nn.Module):
     def __call__(self, image1, image2, iters: int = 12,
                  flow_init: Optional[jax.Array] = None,
                  test_mode: bool = False, train: bool = False,
-                 freeze_bn: bool = False):
+                 freeze_bn: bool = False,
+                 loss_targets: Optional[tuple] = None):
+        """``loss_targets``: optional ``(flow_gt (B,H,W,2), valid (B,H,W),
+        max_flow)`` — fuses the sequence loss into the refinement scan and
+        returns ``(per_iter_losses (iters,), metrics dict of (iters,))``
+        instead of stacked flows (training fast path; the γ-weighting is
+        applied by the caller)."""
         cfg = self.config
         dt = cfg.dtype
         hdim, cdim = cfg.hidden_dim, cfg.context_dim
@@ -173,9 +191,21 @@ class RAFT(nn.Module):
             unroll=cfg.scan_unroll,
         )(cfg, name="refine")
 
-        (net, coords1), flow_ups = scan(
-            (net, coords1), (inp, coords0, corr_state))
+        if loss_targets is not None:
+            from raft_tpu.train.loss import combined_valid
 
+            flow_gt, valid, max_flow = loss_targets
+            valid01 = combined_valid(flow_gt, valid, max_flow)
+            lt = (flow_gt.astype(jnp.float32), valid01)
+            carry0 = (net, coords1,
+                      jnp.zeros(image1.shape[:-1] + (2,), jnp.float32))
+            (_, _, last_flow), per_iter = scan(
+                carry0, (inp, coords0, corr_state, lt))
+            # (per-iteration loss scalars, last upsampled flow)
+            return per_iter, last_flow
+
+        (net, coords1), outs = scan(
+            (net, coords1), (inp, coords0, corr_state, None))
         if test_mode:
-            return coords1 - coords0, flow_ups[-1]
-        return flow_ups
+            return coords1 - coords0, outs[-1]
+        return outs

@@ -34,6 +34,14 @@ def flow_metrics(flow_pred: jnp.ndarray, flow_gt: jnp.ndarray,
     }
 
 
+def combined_valid(flow_gt: jnp.ndarray, valid: jnp.ndarray,
+                   max_flow: float) -> jnp.ndarray:
+    """Loss/metric mask: valid ∧ |flow_gt| < max_flow, as float {0,1}
+    (reference train.py:51-52)."""
+    mag = jnp.sqrt(jnp.sum(flow_gt ** 2, axis=-1))
+    return ((valid > 0.5) & (mag < max_flow)).astype(jnp.float32)
+
+
 def sequence_loss(flow_preds: jnp.ndarray, flow_gt: jnp.ndarray,
                   valid: jnp.ndarray, gamma: float = 0.8,
                   max_flow: float = 400.0
@@ -49,8 +57,7 @@ def sequence_loss(flow_preds: jnp.ndarray, flow_gt: jnp.ndarray,
       not the mean over valid pixels.
     """
     n_predictions = flow_preds.shape[0]
-    mag = jnp.sqrt(jnp.sum(flow_gt ** 2, axis=-1))
-    valid = (valid > 0.5) & (mag < max_flow)
+    valid = combined_valid(flow_gt, valid, max_flow)
     vmask = valid[None, ..., None].astype(flow_preds.dtype)
 
     i = jnp.arange(n_predictions, dtype=flow_preds.dtype)

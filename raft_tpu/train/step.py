@@ -23,7 +23,8 @@ from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.models.raft import RAFT
 from raft_tpu.parallel.mesh import (batch_sharding, replicated_sharding,
                                     spatial_batch_sharding)
-from raft_tpu.train.loss import sequence_loss
+from raft_tpu.train.loss import (combined_valid, flow_metrics,
+                                 sequence_loss)
 from raft_tpu.train.state import TrainState
 
 
@@ -63,14 +64,29 @@ def make_train_step(model: RAFT, tx: optax.GradientTransformation,
             variables["batch_stats"] = batch_stats
             if not cfg.freeze_bn:
                 mutable = ["batch_stats"]
-        out = model.apply(
-            variables, batch["image1"], batch["image2"], iters=cfg.iters,
-            train=True, freeze_bn=cfg.freeze_bn,
-            rngs={"dropout": rng}, mutable=mutable)
-        flow_preds, new_vars = out if mutable else (out, {})
-        loss, metrics = sequence_loss(
-            flow_preds, batch["flow"], batch["valid"],
-            gamma=cfg.gamma, max_flow=cfg.max_flow)
+        kwargs = dict(iters=cfg.iters, train=True, freeze_bn=cfg.freeze_bn,
+                      rngs={"dropout": rng}, mutable=mutable)
+        if cfg.fused_loss:
+            # Sequence loss fused into the scan: per-iteration scalars
+            # instead of stacked full-res flows (same numerics).
+            kwargs["loss_targets"] = (batch["flow"], batch["valid"],
+                                      cfg.max_flow)
+        out = model.apply(variables, batch["image1"], batch["image2"],
+                          **kwargs)
+        out, new_vars = out if mutable else (out, {})
+        if cfg.fused_loss:
+            per_iter, last_flow = out
+            i = jnp.arange(cfg.iters, dtype=per_iter.dtype)
+            weights = cfg.gamma ** (cfg.iters - i - 1.0)
+            loss = jnp.sum(weights * per_iter)
+            metrics = flow_metrics(
+                last_flow, batch["flow"],
+                combined_valid(batch["flow"], batch["valid"],
+                               cfg.max_flow))
+        else:
+            loss, metrics = sequence_loss(
+                out, batch["flow"], batch["valid"],
+                gamma=cfg.gamma, max_flow=cfg.max_flow)
         return loss, (metrics, new_vars.get("batch_stats"))
 
     def step_fn(state: TrainState, batch: Dict, rng: jax.Array):
