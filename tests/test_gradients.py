@@ -1,0 +1,69 @@
+"""Numerical gradient checks on the core differentiable ops
+(SURVEY.md §4: jax.test_util.check_grads — the reference could never do
+this for its CUDA path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.test_util import check_grads
+
+from raft_tpu.ops.corr import (build_corr_pyramid, chunked_corr_lookup,
+                               corr_lookup, pool_fmap_pyramid)
+from raft_tpu.ops.sampler import bilinear_sampler, coords_grid, upflow8
+from raft_tpu.ops.upsample import convex_upsample
+
+B, H, W, C = 1, 8, 10, 8
+
+
+def _rand(shape, seed=0, scale=1.0):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape) * scale,
+        jnp.float32)
+
+
+def test_bilinear_sampler_grads():
+    img = _rand((B, H, W, C))
+    # keep sample points away from integer lattice: |x - round(x)| > eps
+    # (bilinear interpolation is non-differentiable at integers)
+    coords = coords_grid(B, 6, 6) + 0.37
+    check_grads(lambda im, c: bilinear_sampler(im, c), (img, coords),
+                order=1, modes=["rev"], atol=1e-2, rtol=1e-2)
+
+
+def test_upflow8_grads():
+    flow = _rand((B, H, W, 2), 1)
+    check_grads(upflow8, (flow,), order=1, modes=["rev"],
+                atol=1e-2, rtol=1e-2)
+
+
+def test_convex_upsample_grads():
+    flow = _rand((B, H, W, 2), 2)
+    mask = _rand((B, H, W, 9 * 64), 3, scale=0.1)
+    check_grads(convex_upsample, (flow, mask), order=1, modes=["rev"],
+                atol=1e-2, rtol=1e-2)
+
+
+def test_corr_lookup_grads():
+    f1 = _rand((B, H, W, C), 4, 0.5)
+    f2 = _rand((B, H, W, C), 5, 0.5)
+    coords = coords_grid(B, H, W) + 0.29
+
+    def fn(a, b):
+        pyr = build_corr_pyramid(a, b, 2)
+        return corr_lookup(pyr, coords, 2)
+
+    check_grads(fn, (f1, f2), order=1, modes=["rev"],
+                atol=1e-2, rtol=1e-2)
+
+
+def test_chunked_lookup_grads():
+    f1 = _rand((B, H, W, C), 6, 0.5)
+    f2 = _rand((B, H, W, C), 7, 0.5)
+    coords = coords_grid(B, H, W) + 0.31
+
+    def fn(a, b):
+        pyr = pool_fmap_pyramid(b, 2)
+        return chunked_corr_lookup(a, pyr, coords, 2, block_size=32)
+
+    check_grads(fn, (f1, f2), order=1, modes=["rev"],
+                atol=1e-2, rtol=1e-2)
