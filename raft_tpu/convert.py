@@ -15,7 +15,10 @@ This maps them onto :class:`raft_tpu.models.raft.RAFT` variables:
   -> ``mask_conv1``/``mask_conv2``;
 - norm ``weight/bias`` -> ``scale/bias`` under the auto-named
   ``BatchNorm_0``/``GroupNorm_0`` submodule, ``running_mean/var`` -> the
-  ``batch_stats`` collection; ``num_batches_tracked`` is dropped.
+  ``batch_stats`` collection; ``num_batches_tracked`` is dropped;
+- the GRU's separate z/r gate convs (``convz*``/``convr*``) are merged
+  into our fused double-width ``convzr*`` tensors (output-axis concat,
+  z first — see update.py ConvGRU/SepConvGRU).
 
 Conversion is validated structurally: every template leaf must be written
 exactly once with a matching shape, and every torch tensor consumed.
@@ -97,10 +100,34 @@ def _torch_key_to_path(key: str):
     raise ValueError(f"unrecognized torch key: {key}")
 
 
+def _to_np(t) -> np.ndarray:
+    """torch tensor or ndarray -> ndarray."""
+    return np.asarray(getattr(t, "numpy", lambda: t)())
+
+
+def _fuse_gru_zr(state_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge the reference GRU's separate z/r gate convs into the fused
+    double-width ``convzr*`` tensors our model uses (update.py: ConvGRU /
+    SepConvGRU fuse the two same-input convs; concat on the output
+    axis — axis 0 of OIHW weights and of biases)."""
+    out = dict(state_dict)
+    for key in list(state_dict):
+        m = re.fullmatch(r"(.*\.gru\.)convz(\d*)\.(weight|bias)", key)
+        if not m:
+            continue
+        prefix, idx, leaf = m.groups()
+        rkey = f"{prefix}convr{idx}.{leaf}"
+        out[f"{prefix}convzr{idx}.{leaf}"] = np.concatenate(
+            [_to_np(state_dict[key]), _to_np(state_dict[rkey])], axis=0)
+        del out[key], out[rkey]
+    return out
+
+
 def convert_state_dict(state_dict: Dict[str, Any],
                        template: Dict[str, Any]) -> Dict[str, Any]:
     """Map a reference torch ``state_dict`` (tensors or ndarrays) onto the
     flax ``template`` variables ({'params': ..., 'batch_stats': ...})."""
+    state_dict = _fuse_gru_zr(state_dict)
     flat_tmpl = {("params",) + p: v
                  for p, v in _flatten(template["params"]).items()}
     flat_tmpl.update(
@@ -113,7 +140,7 @@ def convert_state_dict(state_dict: Dict[str, Any],
         if mapped is None:
             continue
         coll, path = mapped
-        arr = np.asarray(getattr(tensor, "numpy", lambda: tensor)())
+        arr = _to_np(tensor)
 
         # Resolve the placeholder leaf against the template: norm
         # weight/bias live under an auto-named BatchNorm_0/GroupNorm_0

@@ -46,6 +46,12 @@ class FlowHead(nn.Module):
 
 
 class ConvGRU(nn.Module):
+    """The z and r gates read the same input, so their two convs are fused
+    into one double-width conv + split (identical math; the torch->flax
+    converter concatenates the reference's convz/convr kernels on the
+    output axis).  Wider output channels keep the MXU busier than two
+    narrow convs."""
+
     hidden_dim: int = 128
     dtype: Any = jnp.float32
 
@@ -53,14 +59,18 @@ class ConvGRU(nn.Module):
     def __call__(self, h, x):
         hx = jnp.concatenate([h, x], axis=-1)
         cin = hx.shape[-1]
-        z = nn.sigmoid(_tconv(self.hidden_dim, 3, cin, self.dtype, "convz")(hx))
-        r = nn.sigmoid(_tconv(self.hidden_dim, 3, cin, self.dtype, "convr")(hx))
+        zr = nn.sigmoid(_tconv(2 * self.hidden_dim, 3, cin, self.dtype,
+                               "convzr")(hx))
+        z, r = jnp.split(zr, 2, axis=-1)
         q = jnp.tanh(_tconv(self.hidden_dim, 3, cin, self.dtype, "convq")(
             jnp.concatenate([r * h, x], axis=-1)))
         return (1 - z) * h + z * q
 
 
 class SepConvGRU(nn.Module):
+    """Horizontal (1x5) then vertical (5x1) GRU pass, with the z/r gate
+    convs of each pass fused double-width (see ConvGRU)."""
+
     hidden_dim: int = 128
     dtype: Any = jnp.float32
 
@@ -70,16 +80,18 @@ class SepConvGRU(nn.Module):
         # horizontal pass (1x5 kernels)
         hx = jnp.concatenate([h, x], axis=-1)
         cin = hx.shape[-1]
-        z = nn.sigmoid(_tconv(self.hidden_dim, (1, 5), cin, dt, "convz1")(hx))
-        r = nn.sigmoid(_tconv(self.hidden_dim, (1, 5), cin, dt, "convr1")(hx))
+        zr = nn.sigmoid(_tconv(2 * self.hidden_dim, (1, 5), cin, dt,
+                               "convzr1")(hx))
+        z, r = jnp.split(zr, 2, axis=-1)
         q = jnp.tanh(_tconv(self.hidden_dim, (1, 5), cin, dt, "convq1")(
             jnp.concatenate([r * h, x], axis=-1)))
         h = (1 - z) * h + z * q
 
         # vertical pass (5x1 kernels)
         hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(_tconv(self.hidden_dim, (5, 1), cin, dt, "convz2")(hx))
-        r = nn.sigmoid(_tconv(self.hidden_dim, (5, 1), cin, dt, "convr2")(hx))
+        zr = nn.sigmoid(_tconv(2 * self.hidden_dim, (5, 1), cin, dt,
+                               "convzr2")(hx))
+        z, r = jnp.split(zr, 2, axis=-1)
         q = jnp.tanh(_tconv(self.hidden_dim, (5, 1), cin, dt, "convq2")(
             jnp.concatenate([r * h, x], axis=-1)))
         return (1 - z) * h + z * q
