@@ -24,8 +24,49 @@ from raft_tpu.train.step import init_state, make_train_step
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 30.0
 
 
+def bench_eval():
+    """BENCH_MODE=eval: test-mode forward at the Sintel validation shape
+    (436x1024 padded to 440x1024, 32 iters — reference evaluate.py:96),
+    frames/sec on one chip."""
+    import os
+
+    H, W = 440, 1024
+    iters = int(os.environ.get("BENCH_EVAL_ITERS", 32))
+    cfg = RAFTConfig.full(compute_dtype="bfloat16")
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img = jax.random.uniform(rng, (1, H, W, 3), np.float32) * 255.0
+    variables = model.init({"params": rng, "dropout": rng}, img, img,
+                           iters=2, train=False)
+
+    @jax.jit
+    def fwd(variables, image1, image2):
+        return model.apply(variables, image1, image2, iters=iters,
+                           test_mode=True, train=False)
+
+    for _ in range(2):
+        low, up = fwd(variables, img, img)
+    float(up.sum())
+    n = 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        low, up = fwd(variables, img, img)
+    float(up.sum())
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": f"eval_forward_sintel_440x1024_bf16_iters{iters}",
+        "value": round(n / dt, 3),
+        "unit": "frames/sec/chip",
+        "vs_baseline": 0.0,
+    }))
+
+
 def main():
     import os
+
+    if os.environ.get("BENCH_MODE", "train") == "eval":
+        bench_eval()
+        return
 
     n_dev = jax.device_count()
     mesh = make_mesh(num_data=n_dev, num_spatial=1)
@@ -41,7 +82,7 @@ def main():
     corr_impl = os.environ.get("BENCH_CORR_IMPL", "allpairs")
     corr_precision = os.environ.get("BENCH_CORR_PRECISION", "highest")
     remat = os.environ.get("BENCH_REMAT", "1") == "1"
-    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "full")
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "save_corr")
     scan_unroll = int(os.environ.get("BENCH_SCAN_UNROLL", "1"))
     compute_dtype = os.environ.get("BENCH_COMPUTE_DTYPE", "bfloat16")
     model_cfg = RAFTConfig.full(compute_dtype=compute_dtype,

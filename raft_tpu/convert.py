@@ -12,7 +12,8 @@ This maps them onto :class:`raft_tpu.models.raft.RAFT` variables:
   registers as ``norm3``/``norm4``, extractor.py:41-46) is dropped;
 - ``update_block.`` -> the scan-carried ``refine/update_block``;
 - the mask-head Sequential ``mask.0``/``mask.2`` (update.py:122-125)
-  -> ``mask_conv1``/``mask_conv2``;
+  -> ``upsampler/mask_head/mask_conv1|2`` (the mask head is hoisted out
+  of the refinement scan into the upsample stage, models/raft.py);
 - norm ``weight/bias`` -> ``scale/bias`` under the auto-named
   ``BatchNorm_0``/``GroupNorm_0`` submodule, ``running_mean/var`` -> the
   ``batch_stats`` collection; ``num_batches_tracked`` is dropped;
@@ -80,11 +81,11 @@ def _torch_key_to_path(key: str):
             merged.append(p)
     parts = merged
 
-    # mask Sequential -> mask_conv1/mask_conv2
+    # mask Sequential -> the hoisted upsample-stage mask head
     if "mask" in parts:
         i = parts.index("mask")
         conv = {"0": "mask_conv1", "2": "mask_conv2"}[parts[i + 1]]
-        parts = parts[:i] + [conv] + parts[i + 2:]
+        parts = ["upsampler", "mask_head", conv] + parts[i + 2:]
 
     if parts[0] == "update_block":
         parts = ["refine"] + parts

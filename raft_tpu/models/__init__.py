@@ -1,6 +1,7 @@
 from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
 from raft_tpu.models.update import (
     BasicUpdateBlock,
+    MaskHead,
     ConvGRU,
     FlowHead,
     SepConvGRU,
@@ -12,6 +13,7 @@ __all__ = [
     "BasicEncoder",
     "SmallEncoder",
     "BasicUpdateBlock",
+    "MaskHead",
     "SmallUpdateBlock",
     "ConvGRU",
     "SepConvGRU",

@@ -24,6 +24,8 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
+from jax.ad_checkpoint import checkpoint_name
+
 from raft_tpu.models.layers import conv
 
 
@@ -108,7 +110,11 @@ class SmallMotionEncoder(nn.Module):
         flo = nn.relu(_tconv(32, 3, 64, dt, "convf2")(flo))
         out = nn.relu(_tconv(80, 3, 128, dt, "conv")(
             jnp.concatenate([cor, flo], axis=-1)))
-        return jnp.concatenate([out, flow], axis=-1)
+        # Tagged for remat_policy='save_corr' (saved with the corr taps:
+        # skipping the motion-encoder recompute in backward is nearly
+        # free memory-wise, (B, H/8, W/8, 82|128) per iteration).
+        return checkpoint_name(
+            jnp.concatenate([out, flow], axis=-1), "motion")
 
 
 class BasicMotionEncoder(nn.Module):
@@ -123,7 +129,11 @@ class BasicMotionEncoder(nn.Module):
         flo = nn.relu(_tconv(64, 3, 128, dt, "convf2")(flo))
         out = nn.relu(_tconv(126, 3, 64 + 192, dt, "conv")(
             jnp.concatenate([cor, flo], axis=-1)))
-        return jnp.concatenate([out, flow], axis=-1)
+        # Tagged for remat_policy='save_corr' (saved with the corr taps:
+        # skipping the motion-encoder recompute in backward is nearly
+        # free memory-wise, (B, H/8, W/8, 82|128) per iteration).
+        return checkpoint_name(
+            jnp.concatenate([out, flow], axis=-1), "motion")
 
 
 class SmallUpdateBlock(nn.Module):
@@ -136,10 +146,17 @@ class SmallUpdateBlock(nn.Module):
         x = jnp.concatenate([inp, motion], axis=-1)
         net = ConvGRU(self.hidden_dim, self.dtype, name="gru")(net, x)
         delta_flow = FlowHead(128, self.dtype, name="flow_head")(net)
-        return net, None, delta_flow
+        return net, delta_flow
 
 
 class BasicUpdateBlock(nn.Module):
+    """GRU update *without* the mask head: the convex-upsample mask
+    (reference update.py:122-125) depends only on ``net``, so it is
+    hoisted out of the refinement scan into :class:`MaskHead` (applied per
+    iteration for training, final iteration only for inference — the
+    reference recomputes it every iteration even in test mode,
+    raft.py:127-137)."""
+
     hidden_dim: int = 128
     dtype: Any = jnp.float32
 
@@ -149,8 +166,19 @@ class BasicUpdateBlock(nn.Module):
         x = jnp.concatenate([inp, motion], axis=-1)
         net = SepConvGRU(self.hidden_dim, self.dtype, name="gru")(net, x)
         delta_flow = FlowHead(256, self.dtype, name="flow_head")(net)
+        return net, delta_flow
 
+
+class MaskHead(nn.Module):
+    """Convex-upsample mask head (reference update.py:122-125,135), with
+    the x0.25 scale ("to balence gradients")."""
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, net):
         mask = nn.relu(_tconv(256, 3, self.hidden_dim, self.dtype,
                               "mask_conv1")(net))
         mask = _tconv(64 * 9, 1, 256, self.dtype, "mask_conv2")(mask)
-        return net, 0.25 * mask, delta_flow
+        return 0.25 * mask
