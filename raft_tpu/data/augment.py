@@ -39,25 +39,97 @@ except AttributeError:  # minimal cv2 builds
 
 
 # ---------------------------------------------------------------------------
+# Native acceleration (raft_tpu/native/aug_ops.c)
+#
+# The C kernels fuse resize+flip+crop into one inverse-mapped pass over the
+# output crop only, and run the photometric ops without float temporaries
+# (~4x per-core vs the cv2/NumPy path, and they release the GIL so the
+# loader's thread pool scales).  Every use degrades to the NumPy/cv2 code
+# below when the library is unavailable; both paths consume the RNG in the
+# same order, so seeds stay portable between them.
+# ---------------------------------------------------------------------------
+
+def _nlib():
+    import os
+
+    if os.environ.get("RAFT_TPU_NO_NATIVE_AUG"):
+        return None
+    from raft_tpu.native.build import load
+
+    return load()
+
+
+def _warp_native(lib, arr: np.ndarray, crop: Tuple[int, int], sx: float,
+                 sy: float, rh: int, rw: int, hflip: bool, vflip: bool,
+                 x0: int, y0: int,
+                 chan_scale: Optional[np.ndarray] = None) -> np.ndarray:
+    """Fused resize (cv2 center-aligned bilinear) + flip + crop."""
+    arr = np.ascontiguousarray(arr)
+    h, w = arr.shape[:2]
+    c = 1 if arr.ndim == 2 else arr.shape[2]
+    out_shape = (crop[0], crop[1]) if arr.ndim == 2 \
+        else (crop[0], crop[1], c)
+    out = np.empty(out_shape, arr.dtype)
+    args = (arr.ctypes.data, h, w, c, out.ctypes.data, crop[0], crop[1],
+            sx, sy, rh, rw, int(hflip), int(vflip), x0, y0)
+    if arr.dtype == np.uint8:
+        lib.aug_warp_u8(*args)
+    else:
+        if chan_scale is None:
+            chan_scale = np.ones(c, np.float32)
+        cs = np.ascontiguousarray(chan_scale, np.float32)
+        lib.aug_warp_f32(*args, cs.ctypes.data)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Photometric jitter (torchvision ColorJitter equivalent)
 # ---------------------------------------------------------------------------
 
-def _adjust_brightness(img: np.ndarray, factor: float) -> np.ndarray:
+def _native_buf(img: np.ndarray, inplace: bool) -> np.ndarray:
+    """Contiguous uint8 buffer for an in-place C kernel (reused verbatim
+    when the caller already owns a mutable working copy)."""
+    if inplace and img.dtype == np.uint8 and img.flags.c_contiguous:
+        return img
+    return np.array(img, dtype=np.uint8, order="C")
+
+
+def _adjust_brightness(img: np.ndarray, factor: float,
+                       inplace: bool = False) -> np.ndarray:
     # PIL ImageEnhance.Brightness: blend with black.
+    lib = _nlib()
+    if lib is not None:
+        out = _native_buf(img, inplace)
+        lib.aug_brightness(out.ctypes.data, out.size, factor)
+        return out
     return np.clip(img.astype(np.float32) * factor, 0, 255).astype(np.uint8)
 
 
-def _adjust_contrast(img: np.ndarray, factor: float) -> np.ndarray:
+def _adjust_contrast(img: np.ndarray, factor: float,
+                     inplace: bool = False) -> np.ndarray:
     # PIL ImageEnhance.Contrast: blend with the mean-gray image; PIL uses
     # int(round(mean of L channel)).
+    lib = _nlib()
+    if lib is not None:
+        out = _native_buf(img, inplace)
+        n_px = out.size // 3
+        mean = round(lib.aug_gray_sum(out.ctypes.data, n_px) / n_px)
+        lib.aug_contrast(out.ctypes.data, out.size, factor, float(mean))
+        return out
     gray = cv2.cvtColor(img, cv2.COLOR_RGB2GRAY)
     mean = round(float(gray.mean()))
     out = img.astype(np.float32) * factor + mean * (1.0 - factor)
     return np.clip(out, 0, 255).astype(np.uint8)
 
 
-def _adjust_saturation(img: np.ndarray, factor: float) -> np.ndarray:
+def _adjust_saturation(img: np.ndarray, factor: float,
+                       inplace: bool = False) -> np.ndarray:
     # PIL ImageEnhance.Color: blend with the grayscale image.
+    lib = _nlib()
+    if lib is not None:
+        out = _native_buf(img, inplace)
+        lib.aug_saturation(out.ctypes.data, out.size // 3, factor)
+        return out
     gray = cv2.cvtColor(img, cv2.COLOR_RGB2GRAY)[..., None].astype(np.float32)
     out = img.astype(np.float32) * factor + gray * (1.0 - factor)
     return np.clip(out, 0, 255).astype(np.uint8)
@@ -93,14 +165,22 @@ class ColorJitter:
             "hue": rng.uniform(-self.hue, self.hue),
         }
         ops = ["brightness", "contrast", "saturation", "hue"]
-        for name in rng.permutation(ops):
+        order = rng.permutation(ops)
+
+        # One working copy mutated in place by the native kernels (three
+        # extra 6 MB per-op copies per sample add up); the NumPy fallback
+        # inside each _adjust_* ignores ``inplace`` and returns fresh
+        # arrays as before.  Hue stays in cv2 (HSV round trip).
+        if _nlib() is not None:
+            img = np.array(img, dtype=np.uint8, order="C")
+        for name in order:
             f = factors[str(name)]
             if name == "brightness":
-                img = _adjust_brightness(img, f)
+                img = _adjust_brightness(img, f, inplace=True)
             elif name == "contrast":
-                img = _adjust_contrast(img, f)
+                img = _adjust_contrast(img, f, inplace=True)
             elif name == "saturation":
-                img = _adjust_saturation(img, f)
+                img = _adjust_saturation(img, f, inplace=True)
             else:
                 img = _adjust_hue(img, f)
         return img
@@ -166,7 +246,34 @@ class FlowAugmentor:
         ht, wd = img1.shape[:2]
         sx, sy = self._sample_scales(rng, ht, wd, pad=8)
 
-        if rng.random() < self.spatial_aug_prob:
+        # All random decisions are drawn up front in the historical order,
+        # so the native fused path and the cv2 fallback consume the RNG
+        # identically (seeds stay portable between them).
+        if not (rng.random() < self.spatial_aug_prob):
+            sx = sy = 1.0
+        hflip = vflip = False
+        if self.do_flip:
+            hflip = rng.random() < self.h_flip_prob
+            vflip = rng.random() < self.v_flip_prob
+        # cv2.resize dsize rounding is cvRound = round-half-to-even.
+        rh = int(np.rint(ht * sy)) if sy != 1.0 else ht
+        rw = int(np.rint(wd * sx)) if sx != 1.0 else wd
+        y0 = int(rng.integers(0, rh - self.crop_size[0]))
+        x0 = int(rng.integers(0, rw - self.crop_size[1]))
+
+        lib = _nlib()
+        if lib is not None:
+            # One fused inverse-mapped pass per array, computed over the
+            # output crop only; the flow unit rescale and flip sign fixes
+            # fold into the f32 kernel's channel scale.
+            cs = np.array([sx * (-1.0 if hflip else 1.0),
+                           sy * (-1.0 if vflip else 1.0)], np.float32)
+            warp = lambda a, s: _warp_native(
+                lib, a, self.crop_size, sx, sy, rh, rw, hflip, vflip,
+                x0, y0, s)
+            return warp(img1, None), warp(img2, None), warp(flow, cs)
+
+        if sx != 1.0 or sy != 1.0:
             img1 = cv2.resize(img1, None, fx=sx, fy=sy,
                               interpolation=cv2.INTER_LINEAR)
             img2 = cv2.resize(img2, None, fx=sx, fy=sy,
@@ -174,19 +281,14 @@ class FlowAugmentor:
             flow = cv2.resize(flow, None, fx=sx, fy=sy,
                               interpolation=cv2.INTER_LINEAR)
             flow = flow * [sx, sy]
-
-        if self.do_flip:
-            if rng.random() < self.h_flip_prob:
-                img1 = img1[:, ::-1]
-                img2 = img2[:, ::-1]
-                flow = flow[:, ::-1] * [-1.0, 1.0]
-            if rng.random() < self.v_flip_prob:
-                img1 = img1[::-1, :]
-                img2 = img2[::-1, :]
-                flow = flow[::-1, :] * [1.0, -1.0]
-
-        y0 = int(rng.integers(0, img1.shape[0] - self.crop_size[0]))
-        x0 = int(rng.integers(0, img1.shape[1] - self.crop_size[1]))
+        if hflip:
+            img1 = img1[:, ::-1]
+            img2 = img2[:, ::-1]
+            flow = flow[:, ::-1] * [-1.0, 1.0]
+        if vflip:
+            img1 = img1[::-1, :]
+            img2 = img2[::-1, :]
+            flow = flow[::-1, :] * [1.0, -1.0]
         sl = np.s_[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
         return img1[sl], img2[sl], flow[sl]
 
@@ -249,26 +351,41 @@ class SparseFlowAugmentor(FlowAugmentor):
         scale = 2.0 ** rng.uniform(self.min_scale, self.max_scale)
         sx = sy = max(scale, floor)
 
-        if rng.random() < self.spatial_aug_prob:
+        if not (rng.random() < self.spatial_aug_prob):
+            sx = sy = 1.0
+        hflip = self.do_flip and rng.random() < 0.5
+        # Sparse flow/valid keep the NumPy scatter rescale (nearest-
+        # neighbor semantics, augmentor.py:161-193); only the two images
+        # take the native fused path.
+        if sx != 1.0:
+            flow, valid = resize_sparse_flow_map(flow, valid, fx=sx, fy=sy)
+        rh, rw = valid.shape[:2]
+        if hflip:
+            flow = flow[:, ::-1] * [-1.0, 1.0]
+            valid = valid[:, ::-1]
+
+        y0 = int(rng.integers(0, rh - self.crop_size[0] + self.margin_y))
+        x0 = int(rng.integers(-self.margin_x,
+                              rw - self.crop_size[1] + self.margin_x))
+        y0 = int(np.clip(y0, 0, rh - self.crop_size[0]))
+        x0 = int(np.clip(x0, 0, rw - self.crop_size[1]))
+        sl = np.s_[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+
+        lib = _nlib()
+        if lib is not None:
+            warp = lambda a: _warp_native(
+                lib, a, self.crop_size, sx, sy, rh, rw, hflip, False,
+                x0, y0)
+            return warp(img1), warp(img2), flow[sl], valid[sl]
+
+        if sx != 1.0:
             img1 = cv2.resize(img1, None, fx=sx, fy=sy,
                               interpolation=cv2.INTER_LINEAR)
             img2 = cv2.resize(img2, None, fx=sx, fy=sy,
                               interpolation=cv2.INTER_LINEAR)
-            flow, valid = resize_sparse_flow_map(flow, valid, fx=sx, fy=sy)
-
-        if self.do_flip and rng.random() < 0.5:
+        if hflip:
             img1 = img1[:, ::-1]
             img2 = img2[:, ::-1]
-            flow = flow[:, ::-1] * [-1.0, 1.0]
-            valid = valid[:, ::-1]
-
-        y0 = int(rng.integers(0, img1.shape[0] - self.crop_size[0]
-                              + self.margin_y))
-        x0 = int(rng.integers(-self.margin_x, img1.shape[1]
-                              - self.crop_size[1] + self.margin_x))
-        y0 = int(np.clip(y0, 0, img1.shape[0] - self.crop_size[0]))
-        x0 = int(np.clip(x0, 0, img1.shape[1] - self.crop_size[1]))
-        sl = np.s_[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
         return img1[sl], img2[sl], flow[sl], valid[sl]
 
     def __call__(self, rng, img1, img2, flow, valid):  # type: ignore[override]

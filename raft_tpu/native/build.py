@@ -21,7 +21,7 @@ _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _FAILED = False
 
-_SOURCES = ["png_filters.c"]
+_SOURCES = ["png_filters.c", "aug_ops.c"]
 
 
 def _needs_build() -> bool:
@@ -46,7 +46,7 @@ def load() -> Optional[ctypes.CDLL]:
                 srcs = [os.path.join(_DIR, s) for s in _SOURCES]
                 tmp = _SO + f".tmp.{os.getpid()}"
                 subprocess.run(
-                    ["cc", "-O2", "-shared", "-fPIC", "-o", tmp, *srcs],
+                    ["cc", "-O3", "-shared", "-fPIC", "-o", tmp, *srcs],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, _SO)  # atomic wrt concurrent workers
             lib = ctypes.CDLL(_SO)
@@ -54,7 +54,33 @@ def load() -> Optional[ctypes.CDLL]:
             lib.png_unfilter.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_long, ctypes.c_long, ctypes.c_int]
+            lib.aug_gray_sum.restype = ctypes.c_double
+            lib.aug_gray_sum.argtypes = [ctypes.c_void_p, ctypes.c_long]
+            lib.aug_brightness.restype = None
+            lib.aug_brightness.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_float]
+            lib.aug_contrast.restype = None
+            lib.aug_contrast.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_float,
+                ctypes.c_float]
+            lib.aug_saturation.restype = None
+            lib.aug_saturation.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_float]
+            _warp_common = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_long,
+                ctypes.c_long, ctypes.c_void_p, ctypes.c_long,
+                ctypes.c_long, ctypes.c_double, ctypes.c_double,
+                ctypes.c_long, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+                ctypes.c_long, ctypes.c_long]
+            lib.aug_warp_u8.restype = None
+            lib.aug_warp_u8.argtypes = list(_warp_common)
+            lib.aug_warp_f32.restype = None
+            lib.aug_warp_f32.argtypes = list(_warp_common) + [
+                ctypes.c_void_p]
             _LIB = lib
-        except (OSError, subprocess.SubprocessError):
+        except (OSError, subprocess.SubprocessError, AttributeError):
+            # AttributeError: a stale prebuilt .so missing newer symbols
+            # (mtime games on copied artifacts) — degrade to the NumPy
+            # fallbacks rather than crash callers.
             _FAILED = True
         return _LIB
