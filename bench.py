@@ -32,7 +32,14 @@ def bench_eval():
 
     H, W = 440, 1024
     iters = int(os.environ.get("BENCH_EVAL_ITERS", 32))
-    cfg = RAFTConfig.full(compute_dtype="bfloat16")
+    # allpairs (XLA einsums) wins at eval shapes: Sintel's 1/8-res width
+    # is 128 = a full lane tile, so the einsum contraction keeps the MXU
+    # busy (measured 12.0 vs 10.4 frames/s for allpairs_pallas, whose
+    # VPU cost scales with the larger Hl*Wl); the Pallas kernel wins at
+    # training crops (62-wide rows, see main()).
+    cfg = RAFTConfig.full(
+        compute_dtype="bfloat16",
+        corr_impl=os.environ.get("BENCH_CORR_IMPL", "allpairs"))
     model = RAFT(cfg)
     rng = jax.random.PRNGKey(0)
     img = jax.random.uniform(rng, (1, H, W, 3), np.float32) * 255.0
@@ -72,18 +79,23 @@ def main():
     mesh = make_mesh(num_data=n_dev, num_spatial=1)
 
     H, W = 368, 496           # chairs crop, train_standard.sh:3
-    # Batch 12/chip measured ~27% faster per-pair than 6 (amortizes the
-    # fixed per-step work); 24 regresses (HBM pressure).
-    per_chip_batch = int(os.environ.get("BENCH_BATCH", 12))
+    # Batch sweep (v5e, allpairs_pallas, unroll 3): 12 -> 17.5,
+    # 16 -> 18.4; 24 regressed under the XLA path (HBM pressure).
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", 16))
     B = per_chip_batch * n_dev
-    # allpairs is the fast training path on TPU (the pallas/chunked paths
-    # trade speed for O((HW)^2) memory, like the reference's alternate
-    # corr, README.md:75-80).
-    corr_impl = os.environ.get("BENCH_CORR_IMPL", "allpairs")
+    # allpairs_pallas: materialized pyramid + fused Pallas window sampling
+    # — fastest measured training path (17.5 vs 16.2 pairs/s/chip over
+    # the XLA einsum lookup at batch 12).  The pallas/chunked impls trade
+    # speed for O((HW)^2) memory, like the reference's alternate corr
+    # (README.md:75-80).
+    corr_impl = os.environ.get("BENCH_CORR_IMPL", "allpairs_pallas")
     corr_precision = os.environ.get("BENCH_CORR_PRECISION", "highest")
     remat = os.environ.get("BENCH_REMAT", "1") == "1"
-    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "save_corr")
-    scan_unroll = int(os.environ.get("BENCH_SCAN_UNROLL", "1"))
+    _defaults = RAFTConfig()
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY",
+                                  _defaults.remat_policy)
+    scan_unroll = int(os.environ.get("BENCH_SCAN_UNROLL",
+                                     _defaults.scan_unroll))
     compute_dtype = os.environ.get("BENCH_COMPUTE_DTYPE", "bfloat16")
     model_cfg = RAFTConfig.full(compute_dtype=compute_dtype,
                                 corr_impl=corr_impl,

@@ -1,0 +1,7 @@
+#!/usr/bin/env python
+"""Root-level demo entry point (reference ``python demo.py``,
+demo.py:66-75).  All logic lives in :mod:`raft_tpu.cli.demo`."""
+from raft_tpu.cli.demo import main
+
+if __name__ == "__main__":
+    main()

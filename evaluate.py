@@ -1,0 +1,7 @@
+#!/usr/bin/env python
+"""Root-level evaluation entry point (reference ``python evaluate.py``,
+evaluate.py:169-195).  All logic lives in :mod:`raft_tpu.cli.evaluate`."""
+from raft_tpu.cli.evaluate import main
+
+if __name__ == "__main__":
+    main()

@@ -31,10 +31,15 @@ class RAFTConfig:
     corr_levels: int = 4
     corr_radius: int = 4
     dropout: float = 0.0
-    # 'allpairs' materializes the pyramid (reference CorrBlock, corr.py:12-60);
-    # 'chunked' is the memory-efficient blockwise path (reference
-    # AlternateCorrBlock + alt_cuda_corr, corr.py:63-91); 'pallas' is the
-    # fused TPU kernel version of 'chunked'.
+    # 'allpairs' materializes the pyramid (reference CorrBlock, corr.py:12-60)
+    # and samples it with XLA einsums; 'allpairs_pallas' materializes the
+    # same pyramid but samples it with a fused Pallas VPU kernel (both
+    # interpolation stages in VMEM) — faster for training crops (17.5 vs
+    # 16.2 pairs/s/chip at 368x496 batch 12 on v5e) while 'allpairs' wins
+    # at wide eval shapes (Sintel W/8=128 fills the MXU lane tile: 12.0
+    # vs 10.4 frames/s); 'chunked' is the memory-efficient blockwise path
+    # (reference AlternateCorrBlock + alt_cuda_corr, corr.py:63-91);
+    # 'pallas' is the fused TPU kernel version of 'chunked'.
     corr_impl: str = "allpairs"
     # Pixels per block for the chunked/pallas on-demand correlation path.
     corr_block_size: int = 256
@@ -58,8 +63,11 @@ class RAFTConfig:
     # einsum outputs (measured slower: HBM pressure).
     remat_policy: str = "save_corr"
     # Refinement-scan unroll factor (lax.scan unroll): trades compile
-    # time/code size for less per-iteration loop overhead.
-    scan_unroll: int = 1
+    # time/code size for less per-iteration loop overhead.  With the
+    # lighter scan body (upsample hoisted out) + save_corr, unroll pays:
+    # measured 1/2/3/4 -> 15.8/16.2/16.2/16.1 pairs/s/chip on v5e (it
+    # lost with the old heavy body; re-measure if the body changes).
+    scan_unroll: int = 3
     # Rematerialize the upsample stage (mask head + convex upsample, which
     # runs in its own scan *after* the GRU refinement scan) in backward.
     # Its residuals are ~1-2 GB at training shapes; recompute is two convs

@@ -49,6 +49,7 @@ from raft_tpu.models.update import (BasicUpdateBlock, MaskHead,
                                     SmallUpdateBlock)
 from raft_tpu.ops.corr import (
     build_corr_pyramid,
+    build_corr_pyramid_flat,
     chunked_corr_lookup,
     corr_lookup,
     pool_fmap_pyramid,
@@ -82,6 +83,12 @@ class RefinementStep(nn.Module):
                                        cfg.corr_radius,
                                        block_size=cfg.corr_block_size,
                                        precision=cfg.corr_precision)
+        elif cfg.corr_impl == "allpairs_pallas":
+            from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup
+
+            corr = pallas_pyramid_lookup(corr_state, coords1,
+                                         cfg.corr_radius,
+                                         min(cfg.corr_block_size, 128))
         elif cfg.corr_impl == "pallas":
             from raft_tpu.ops.pallas_corr import pallas_corr_lookup
 
@@ -96,14 +103,14 @@ class RefinementStep(nn.Module):
         # keep them (and only them) for the backward pass: the window
         # sampling is ~half the forward iteration, and its taps are small
         # (B, H/8, W/8, levels*(2r+1)^2).
-        corr = checkpoint_name(corr, "corr")
+        corr = checkpoint_name(corr.astype(dt), "corr")
 
         flow = coords1 - coords0
         if cfg.small:
             block = SmallUpdateBlock(cfg.hidden_dim, dt, name="update_block")
         else:
             block = BasicUpdateBlock(cfg.hidden_dim, dt, name="update_block")
-        net, delta_flow = block(net, inp, corr.astype(dt), flow.astype(dt))
+        net, delta_flow = block(net, inp, corr, flow.astype(dt))
 
         coords1 = coords1 + delta_flow.astype(jnp.float32)
         new_flow = coords1 - coords0
@@ -171,6 +178,10 @@ class RAFT(nn.Module):
         if cfg.corr_impl == "allpairs":
             corr_state = build_corr_pyramid(fmap1, fmap2, cfg.corr_levels,
                                             cfg.corr_precision)
+        elif cfg.corr_impl == "allpairs_pallas":
+            corr_state = build_corr_pyramid_flat(
+                fmap1, fmap2, cfg.corr_levels, cfg.corr_precision,
+                pad_q=min(cfg.corr_block_size, 128))
         elif cfg.corr_impl in ("chunked", "pallas"):
             corr_state = (fmap1, pool_fmap_pyramid(fmap2, cfg.corr_levels))
         else:

@@ -98,6 +98,34 @@ def build_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array,
     return pyramid
 
 
+def build_corr_pyramid_flat(fmap1: jax.Array, fmap2: jax.Array,
+                            num_levels: int = 4, precision="highest",
+                            pad_q: int = 128) -> List[jax.Array]:
+    """Materialized pyramid with the query dim flattened and zero-padded to
+    a multiple of ``pad_q``: level l is ``(B, Npad, H/2^l, W/2^l)``.
+
+    Same math as :func:`build_corr_pyramid` (padding ``fmap1`` with zero
+    rows just appends all-zero correlation rows); the layout feeds
+    :func:`raft_tpu.ops.pallas_corr.pallas_pyramid_lookup` without a
+    per-iteration pad of the 400 MB volume."""
+    B, H, W, C = fmap1.shape
+    N = H * W
+    n_pad = (-N) % pad_q
+    f1 = fmap1.reshape(B, N, C).astype(jnp.float32)
+    if n_pad:
+        f1 = jnp.pad(f1, ((0, 0), (0, n_pad), (0, 0)))
+    f2 = fmap2.reshape(B, N, C).astype(jnp.float32)
+    corr = jnp.einsum("bnc,bmc->bnm", f1, f2,
+                      precision=resolve_precision(precision),
+                      preferred_element_type=jnp.float32)
+    corr = (corr / jnp.sqrt(jnp.float32(C))).reshape(B, N + n_pad, H, W)
+    pyramid = [corr]
+    for _ in range(num_levels - 1):
+        corr = _avg_pool_2x2(corr)
+        pyramid.append(corr)
+    return pyramid
+
+
 def _interp_weights_1d(c: jax.Array, n: int, radius: int) -> jax.Array:
     """Dense bilinear interpolation weights along one axis.
 

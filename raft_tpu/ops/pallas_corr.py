@@ -193,15 +193,24 @@ def _bwd_kernel(f1_ref, c_ref, f2_ref, g_ref, df1_ref, df2_ref, *,
     df1_ref[0] = df1
 
 
+def _pad_coords_oor(coords, npad):
+    """Pad the query dim to ``npad`` with far-out-of-range centers — every
+    window weight becomes zero (the sampler's zeros-padding semantics), so
+    padded queries contribute nothing in forward or backward."""
+    pad = npad - coords.shape[1]
+    if not pad:
+        return coords
+    return jnp.pad(coords, ((0, 0), (0, pad), (0, 0)),
+                   constant_values=-1e6)
+
+
 def _pad_queries(f1, coords, block_q):
     B, N, C = f1.shape
     nblocks = -(-N // block_q)
     pad = nblocks * block_q - N
     if pad:
         f1 = jnp.pad(f1, ((0, 0), (0, pad), (0, 0)))
-        # Far-out-of-range centers make every window weight zero.
-        coords = jnp.pad(coords, ((0, 0), (0, pad), (0, 0)),
-                         constant_values=-1e6)
+        coords = _pad_coords_oor(coords, nblocks * block_q)
     return f1, coords, nblocks
 
 
@@ -280,6 +289,224 @@ def _level_bwd(f1p, coords_p, f2, g, level, radius, block_q, interpret):
 
 def _auto_interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Fused lookup over the MATERIALIZED pyramid (the allpairs training path)
+#
+# The XLA window-sampling einsums (raft_tpu.ops.corr._sample_windows) are
+# batched (K, Hl) x (Hl, Wl) mat-muls per query — M=9 streaming rows and a
+# 46-of-128 contraction leave the MXU mostly idle.  Here the same math runs
+# on the VPU with queries in the sublane dim: for each image row y, each of
+# the K vertical taps accumulates ``wy_j(y) * row_y`` as one (BQ, Wl)
+# fused-multiply-add, then the K horizontal taps contract x with a lane
+# reduction — both interpolation stages fused in VMEM, the (BQ, K, Wl)
+# intermediate never touches HBM.  ~10x faster than the einsum pair in
+# isolation on v5e.
+#
+# The backward is the exact transpose, and is race-free by construction:
+# each query owns its correlation row, so ``dcorr`` blocks never overlap
+# (grid = (B, N/BQ) writes disjoint (BQ, Hl, Wl) slabs) — no atomics, no
+# sequential-grid accumulation.
+# ---------------------------------------------------------------------------
+
+_ROW_TILE = 8
+
+
+def _pyr_fwd_kernel(corr_ref, c_ref, out_ref, *, hl, wl, k, lvl_div):
+    """corr_ref: (1, BQ, hl, wl); c_ref: (1, BQ, 2); out: (1, BQ, k*k).
+    Queries live in sublanes; x in lanes."""
+    bq = corr_ref.shape[1]
+    r = (k - 1) // 2
+    cx = c_ref[0, :, 0:1] * lvl_div      # (BQ, 1)
+    cy = c_ref[0, :, 1:2] * lvl_div
+    posx = jax.lax.broadcasted_iota(jnp.int32, (bq, wl), 1) \
+        .astype(jnp.float32)
+    wx = [_tap_weight(cx, float(i - r), posx) for i in range(k)]
+
+    T = min(_ROW_TILE, hl)
+    nt = hl // T
+
+    def tile_body(t, accs):
+        blk = corr_ref[0, :, pl.ds(t * T, T), :]     # (BQ, T, wl)
+        y0 = (t * T).astype(jnp.float32)
+        for yi in range(T):
+            row = blk[:, yi, :]
+            for j in range(k):
+                accs[j] += _tap_weight(cy, float(j - r - yi), y0) * row
+        return accs
+
+    accs = jax.lax.fori_loop(
+        0, nt, tile_body,
+        [jnp.zeros((bq, wl), jnp.float32) for _ in range(k)])
+    if hl % T:  # static remainder rows
+        rem = nt * T
+        blk = corr_ref[0, :, rem:, :]
+        for yi in range(hl - rem):
+            row = blk[:, yi, :]
+            for j in range(k):
+                accs[j] += _tap_weight(cy, float(j - r - yi),
+                                       float(rem)) * row
+
+    for i in range(k):
+        for j in range(k):
+            out_ref[0, :, i * k + j] = jnp.sum(wx[i] * accs[j], axis=1)
+
+
+def _pyr_bwd_kernel(c_ref, g_ref, dcorr_ref, *, hl, wl, k, lvl_div):
+    """Transpose of :func:`_pyr_fwd_kernel`:
+    ``dcorr(q, y, x) = sum_ij wy_j(q, y) g(q, i, j) wx_i(q, x)``."""
+    bq = c_ref.shape[1]
+    r = (k - 1) // 2
+    cx = c_ref[0, :, 0:1] * lvl_div
+    cy = c_ref[0, :, 1:2] * lvl_div
+    posx = jax.lax.broadcasted_iota(jnp.int32, (bq, wl), 1) \
+        .astype(jnp.float32)
+
+    # b_j(q, x) = sum_i wx_i(q, x) g(q, i*k+j)
+    b = [sum(_tap_weight(cx, float(i - r), posx)
+             * g_ref[0, :, i * k + j:i * k + j + 1]
+             for i in range(k)) for j in range(k)]
+
+    T = min(_ROW_TILE, hl)
+    nt = hl // T
+
+    def _rows(y0f, yis):
+        return jnp.stack([
+            sum(_tap_weight(cy, float(j - r - yi), y0f) * b[j]
+                for j in range(k)) for yi in yis
+        ], axis=1)                                       # (BQ, T, wl)
+
+    def tile_body(t, _):
+        dcorr_ref[0, :, pl.ds(t * T, T), :] = _rows(
+            (t * T).astype(jnp.float32), range(T))
+        return 0
+
+    jax.lax.fori_loop(0, nt, tile_body, 0)
+    if hl % T:
+        rem = nt * T
+        dcorr_ref[0, :, rem:, :] = _rows(float(rem), range(hl - rem))
+
+
+def _pyr_level_fwd(corr, coords_p, level, radius, block_q, interpret):
+    B, Npad, hl, wl = corr.shape
+    k = 2 * radius + 1
+    kern = functools.partial(_pyr_fwd_kernel, hl=hl, wl=wl, k=k,
+                             lvl_div=1.0 / (2.0 ** level))
+    return pl.pallas_call(
+        kern,
+        grid=(B, Npad // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hl, wl), lambda b, i: (b, i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, k * k), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, Npad, k * k), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(corr, coords_p)
+
+
+def _pyr_level_bwd(coords_p, g_l, level, radius, block_q, hl, wl,
+                   interpret):
+    B, Npad, _ = coords_p.shape
+    k = 2 * radius + 1
+    kern = functools.partial(_pyr_bwd_kernel, hl=hl, wl=wl, k=k,
+                             lvl_div=1.0 / (2.0 ** level))
+    return pl.pallas_call(
+        kern,
+        grid=(B, Npad // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, k * k), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hl, wl),
+                               lambda b, i: (b, i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, Npad, hl, wl), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(coords_p, g_l)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def pallas_pyramid_lookup(pyramid, coords, radius: int = 4,
+                          block_q: int = 128, interpret=None):
+    """Fused window sampling of a MATERIALIZED correlation pyramid.
+
+    Drop-in replacement for :func:`raft_tpu.ops.corr.corr_lookup` (the
+    reference ``CorrBlock.__call__``, corr.py:29-50) — same tap-order
+    contract, same zeros-padding bilinear semantics.
+
+    Args:
+      pyramid: list of ``(B, Npad, Hl, Wl)`` fp32 levels whose query dim is
+        already padded to a multiple of ``block_q`` (pad ``fmap1`` before
+        ``build_corr_pyramid`` — zero rows correlate to zero).
+      coords: ``(B, H1, W1, 2)`` level-0 centroids (N = H1*W1 real
+        queries), last axis ``(x, y)``.
+
+    Returns:
+      ``(B, H1, W1, L * (2r+1)^2)`` fp32 lookup features.
+    """
+    out, _ = _pyr_fwd(pyramid, coords, radius, block_q, interpret)
+    return out
+
+
+def _pyr_fwd(pyramid, coords, radius, block_q, interpret):
+    if interpret is None:
+        interpret = _auto_interpret()
+    B, H1, W1, _ = coords.shape
+    N = H1 * W1
+    Npad = pyramid[0].shape[1]
+    k = 2 * radius + 1
+    c = _pad_coords_oor(coords.reshape(B, N, 2).astype(jnp.float32), Npad)
+    outs = []
+    for lvl, lvl_corr in enumerate(pyramid):
+        if lvl_corr.shape[2] == 0 or lvl_corr.shape[3] == 0:
+            # Over-pooled tiny input: empty level samples as all zeros.
+            outs.append(jnp.zeros((B, Npad, k * k), jnp.float32))
+            continue
+        outs.append(_pyr_level_fwd(lvl_corr, c, lvl, radius, block_q,
+                                   interpret))
+    out = jnp.concatenate([o[:, :N] for o in outs], axis=-1)
+    return (out.reshape(B, H1, W1, len(pyramid) * k * k),
+            (tuple(x.shape for x in pyramid), coords))
+
+
+def _pyr_bwd(radius, block_q, interpret, residuals, g):
+    shapes, coords = residuals
+    if interpret is None:
+        interpret = _auto_interpret()
+    B, H1, W1, _ = coords.shape
+    N = H1 * W1
+    Npad = shapes[0][1]
+    k = 2 * radius + 1
+    c = _pad_coords_oor(coords.reshape(B, N, 2).astype(jnp.float32), Npad)
+    g = g.reshape(B, N, -1).astype(jnp.float32)
+    if Npad != N:
+        g = jnp.pad(g, ((0, 0), (0, Npad - N), (0, 0)))
+    dpyr = []
+    for lvl, shape in enumerate(shapes):
+        _, _, hl, wl = shape
+        if hl == 0 or wl == 0:
+            dpyr.append(jnp.zeros(shape, jnp.float32))
+            continue
+        g_l = g[:, :, lvl * k * k:(lvl + 1) * k * k]
+        dpyr.append(_pyr_level_bwd(c, g_l, lvl, radius, block_q, hl, wl,
+                                   interpret))
+    # container must match the primal's (build_corr_pyramid_flat returns a
+    # list)
+    return dpyr, jnp.zeros_like(coords)
+
+
+pallas_pyramid_lookup.defvjp(_pyr_fwd, _pyr_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -102,3 +102,73 @@ def test_model_with_pallas_corr_runs():
     flows_ref = RAFT(cfg_ref).apply(variables, img, img, iters=2)
     np.testing.assert_allclose(np.asarray(flows), np.asarray(flows_ref),
                                rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Fused materialized-pyramid lookup (allpairs_pallas path)
+# ---------------------------------------------------------------------------
+
+def test_pyramid_lookup_matches_xla():
+    from raft_tpu.ops.corr import build_corr_pyramid_flat
+    from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup
+
+    f1, f2, coords = _setup(2)
+    want = np.asarray(
+        corr_lookup(build_corr_pyramid(f1, f2, LEVELS), coords, RADIUS))
+    pyr = build_corr_pyramid_flat(f1, f2, LEVELS, pad_q=64)
+    got = np.asarray(pallas_pyramid_lookup(pyr, coords, RADIUS, 64))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_pyramid_lookup_out_of_range_coords_zero():
+    from raft_tpu.ops.corr import build_corr_pyramid_flat
+    from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup
+
+    f1, f2, _ = _setup(3)
+    coords = jnp.full((B, H, W, 2), -100.0)   # every window out of range
+    pyr = build_corr_pyramid_flat(f1, f2, LEVELS, pad_q=64)
+    got = np.asarray(pallas_pyramid_lookup(pyr, coords, RADIUS, 64))
+    assert np.all(got == 0.0)
+
+
+def test_pyramid_lookup_grads_match_xla():
+    from raft_tpu.ops.corr import build_corr_pyramid_flat
+    from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup
+
+    f1, f2, coords = _setup(4)
+
+    def loss_ref(f1, f2):
+        p = build_corr_pyramid(f1, f2, LEVELS)
+        return jnp.sum(jnp.sin(corr_lookup(p, coords, RADIUS)))
+
+    def loss_new(f1, f2):
+        p = build_corr_pyramid_flat(f1, f2, LEVELS, pad_q=64)
+        return jnp.sum(jnp.sin(pallas_pyramid_lookup(p, coords, RADIUS,
+                                                     64)))
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1))(f1, f2)
+    g_new = jax.grad(loss_new, argnums=(0, 1))(f1, f2)
+    np.testing.assert_allclose(np.asarray(g_new[0]), np.asarray(g_ref[0]),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g_new[1]), np.asarray(g_ref[1]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_model_allpairs_pallas_matches_allpairs():
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    rng = np.random.default_rng(5)
+    img1 = jnp.asarray(rng.uniform(0, 255, (1, 48, 64, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (1, 48, 64, 3)), jnp.float32)
+    base = RAFTConfig.full()
+    v = RAFT(base).init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(0)},
+                        img1, img2, iters=1)
+    outs = {}
+    for impl in ("allpairs", "allpairs_pallas"):
+        model = RAFT(base.replace(corr_impl=impl))
+        outs[impl] = np.asarray(
+            model.apply(v, img1, img2, iters=2, test_mode=True)[1])
+    np.testing.assert_allclose(outs["allpairs_pallas"], outs["allpairs"],
+                               rtol=1e-4, atol=1e-4)

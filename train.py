@@ -1,0 +1,7 @@
+#!/usr/bin/env python
+"""Root-level training entry point (reference repo UX: ``python train.py``,
+train.py:217-246).  All logic lives in :mod:`raft_tpu.cli.train`."""
+from raft_tpu.cli.train import main
+
+if __name__ == "__main__":
+    main()
