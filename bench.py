@@ -46,10 +46,11 @@ def bench_eval():
     variables = model.init({"params": rng, "dropout": rng}, img, img,
                            iters=2, train=False)
 
-    @jax.jit
-    def fwd(variables, image1, image2):
-        return model.apply(variables, image1, image2, iters=iters,
-                           test_mode=True, train=False)
+    # The real inference entry point (it pins scan_unroll=1 — the config
+    # default tunes the training backward pass).
+    from raft_tpu.evaluate import make_eval_fn
+
+    fwd = make_eval_fn(cfg, iters)
 
     for _ in range(2):
         low, up = fwd(variables, img, img)
@@ -97,11 +98,11 @@ def main():
     scan_unroll = int(os.environ.get("BENCH_SCAN_UNROLL",
                                      _defaults.scan_unroll))
     compute_dtype = os.environ.get("BENCH_COMPUTE_DTYPE", "bfloat16")
-    model_cfg = RAFTConfig.full(compute_dtype=compute_dtype,
-                                corr_impl=corr_impl,
-                                corr_precision=corr_precision,
-                                remat=remat, remat_policy=remat_policy,
-                                scan_unroll=scan_unroll)
+    model_cfg = RAFTConfig.full(
+        compute_dtype=compute_dtype, corr_impl=corr_impl,
+        corr_precision=corr_precision, remat=remat,
+        remat_policy=remat_policy, scan_unroll=scan_unroll,
+        remat_upsample=os.environ.get("BENCH_REMAT_UPSAMPLE", "1") == "1")
     cfg = TrainConfig(num_steps=1000, batch_size=B, image_size=(H, W),
                       iters=12)
 

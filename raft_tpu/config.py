@@ -65,9 +65,10 @@ class RAFTConfig:
     # Refinement-scan unroll factor (lax.scan unroll): trades compile
     # time/code size for less per-iteration loop overhead.  With the
     # lighter scan body (upsample hoisted out) + save_corr, unroll pays:
-    # measured 1/2/3/4 -> 15.8/16.2/16.2/16.1 pairs/s/chip on v5e (it
-    # lost with the old heavy body; re-measure if the body changes).
-    scan_unroll: int = 3
+    # measured 1/2/3/4/6/12 -> 15.8/16.2/16.2/16.1/18.7(batch 16)/OOM
+    # pairs/s/chip on v5e (it lost with the old heavy body; re-measure
+    # if the body changes).
+    scan_unroll: int = 6
     # Rematerialize the upsample stage (mask head + convex upsample, which
     # runs in its own scan *after* the GRU refinement scan) in backward.
     # Its residuals are ~1-2 GB at training shapes; recompute is two convs
