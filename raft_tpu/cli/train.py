@@ -45,8 +45,13 @@ def parse_args(argv=None):
                    help="exponential loss weighting")
     p.add_argument("--add_noise", action="store_true")
     p.add_argument("--seed", type=int, default=1234)
-    p.add_argument("--corr_impl", default="allpairs",
-                   choices=["allpairs", "chunked", "pallas"])
+    p.add_argument("--corr_impl", default="auto",
+                   choices=["auto", "allpairs", "allpairs_pallas",
+                            "chunked", "pallas"],
+                   help="'auto' = allpairs_pallas on TPU (fastest "
+                        "measured at every curriculum crop; the XLA "
+                        "allpairs path OOMs at the things stage), "
+                        "allpairs elsewhere (no interpret-mode Pallas)")
     p.add_argument("--data_root", default="datasets")
     p.add_argument("--chairs_split", default="chairs_split.txt")
     p.add_argument("--ckpt_dir", default="checkpoints")
@@ -83,8 +88,12 @@ def main(argv=None):
     from raft_tpu.train.step import init_state
 
     compute_dtype = "bfloat16" if args.precision == "bf16" else "float32"
+    corr_impl = args.corr_impl
+    if corr_impl == "auto":
+        corr_impl = ("allpairs_pallas" if jax.default_backend() == "tpu"
+                     else "allpairs")
     mk = RAFTConfig.small_model if args.small else RAFTConfig.full
-    model_cfg = mk(dropout=args.dropout, corr_impl=args.corr_impl,
+    model_cfg = mk(dropout=args.dropout, corr_impl=corr_impl,
                    compute_dtype=compute_dtype)
     cfg = TrainConfig(
         name=args.name, stage=args.stage, restore_ckpt=args.restore_ckpt,

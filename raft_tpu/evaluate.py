@@ -41,11 +41,18 @@ def make_eval_fn(model_cfg: RAFTConfig, iters: int):
     flow_up)`` test-mode forward.  ``flow_init`` may be None (traced as a
     static branch via two separate jit entries).
 
-    The scan unroll is forced to 1 here: the config default tunes the
-    training backward pass, but at 32 forward-only iterations unroll 6
-    measured 10.8 vs 11.9 frames/s on v5e — every inference entry point
-    funnels through this function, so the override lives here once."""
-    model = RAFT(model_cfg.replace(scan_unroll=1))
+    Inference-only overrides live here once (every inference entry point
+    funnels through this function): the scan unroll is forced to 1 (the
+    config default tunes the training backward pass; at 32 forward-only
+    iterations unroll 6 measured 10.8 vs 11.9 frames/s on v5e), and the
+    training-optimized ``allpairs_pallas`` impl maps back to ``allpairs``
+    (10.4 vs 12.0 frames/s at the Sintel eval shape, whose W/8=128 rows
+    fill the MXU lane tile).  Explicit memory-saving choices (``chunked``
+    / ``pallas``) are respected."""
+    overrides = {"scan_unroll": 1}
+    if model_cfg.corr_impl == "allpairs_pallas":
+        overrides["corr_impl"] = "allpairs"
+    model = RAFT(model_cfg.replace(**overrides))
 
     @jax.jit
     def fwd(variables, image1, image2):

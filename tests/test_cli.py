@@ -189,3 +189,18 @@ def test_train_cli_curriculum_restore(chairs_tree, monkeypatch):
     run_dir = chairs_tree / "ckpts" / "stage-b"
     steps = [d for d in os.listdir(run_dir) if d.isdigit()]
     assert steps, os.listdir(run_dir)
+
+
+def test_root_entry_point_shims():
+    """The repo-root train.py/evaluate.py/demo.py shims (reference repo
+    UX) expose the same argparse surface as the raft_tpu.cli modules."""
+    import subprocess
+    import sys
+
+    repo_root = osp.dirname(osp.dirname(osp.abspath(__file__)))
+    for script in ("train.py", "evaluate.py", "demo.py"):
+        r = subprocess.run([sys.executable, script, "--help"],
+                           capture_output=True, text=True, cwd=repo_root,
+                           timeout=120)
+        assert r.returncode == 0, (script, r.stderr[-400:])
+        assert "usage:" in r.stdout
