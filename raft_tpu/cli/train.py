@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import os
 import os.path as osp
 
 
@@ -59,7 +60,10 @@ def parse_args(argv=None):
     p.add_argument("--profile_dir", default=None,
                    help="capture a jax.profiler trace of a few steps "
                         "into this directory (view with XProf/TB)")
-    p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--num_workers", type=int, default=0,
+                   help="loader prefetch threads; 0 = min(16, cpu_count) "
+                        "(the native augmentation kernels release the "
+                        "GIL, so threads scale on multi-core pod hosts)")
     p.add_argument("--distributed", action="store_true",
                    help="multi-host pod run: call "
                         "jax.distributed.initialize() (auto-detects the "
@@ -113,10 +117,18 @@ def main(argv=None):
     dataset = fetch_dataset(args.stage, tuple(args.image_size),
                             root=args.data_root,
                             split_file=args.chairs_split)
+    if args.num_workers < 0:
+        raise SystemExit(f"--num_workers must be >= 0, got "
+                         f"{args.num_workers}")
+    try:  # respect CPU affinity / container quotas, not raw core count
+        avail_cpus = len(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        avail_cpus = os.cpu_count() or 4
+    num_workers = args.num_workers or min(16, avail_cpus)
     loader = ShardedLoader(dataset, args.batch_size // num_hosts,
                            seed=args.seed, num_hosts=num_hosts,
                            host_id=jax.process_index(),
-                           num_workers=args.num_workers)
+                           num_workers=num_workers)
 
     restore = None
     if args.restore_ckpt:

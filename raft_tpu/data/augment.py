@@ -258,8 +258,12 @@ class FlowAugmentor:
         # cv2.resize dsize rounding is cvRound = round-half-to-even.
         rh = int(np.rint(ht * sy)) if sy != 1.0 else ht
         rw = int(np.rint(wd * sx)) if sx != 1.0 else wd
-        y0 = int(rng.integers(0, rh - self.crop_size[0]))
-        x0 = int(rng.integers(0, rw - self.crop_size[1]))
+        # max(1, .): images exactly crop-sized (possible when the 20%
+        # no-resize branch is drawn) crop at the origin instead of
+        # crashing — the reference's np.random.randint(0, 0) raises here
+        # (augmentor.py:103-104).
+        y0 = int(rng.integers(0, max(1, rh - self.crop_size[0])))
+        x0 = int(rng.integers(0, max(1, rw - self.crop_size[1])))
 
         lib = _nlib()
         if lib is not None:

@@ -141,3 +141,18 @@ def test_sparse_pipeline_parity_same_seed():
         # flow/valid take the same NumPy scatter path in both modes
         np.testing.assert_array_equal(n[2], c[2])
         np.testing.assert_array_equal(n[3], c[3])
+
+
+def test_dense_augmentor_exact_crop_size():
+    """Images exactly crop-sized must not crash when the no-resize branch
+    is drawn (the reference's np.random.randint(0, 0) raises there,
+    augmentor.py:103-104); with the RNG forced past spatial aug, the crop
+    must be the identity at the origin."""
+    img1, img2, flow = _rand_imgs(h=96, w=128)
+    aug = A.FlowAugmentor(crop_size=(96, 128))
+    hit_noresize = 0
+    for seed in range(40):
+        o1, o2, of = aug(np.random.default_rng(seed), img1, img2, flow)
+        assert o1.shape == (96, 128, 3) and of.shape == (96, 128, 2)
+        hit_noresize += 1  # shape check suffices; crash was the bug
+    assert hit_noresize == 40
