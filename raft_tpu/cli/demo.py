@@ -18,8 +18,11 @@ import os.path as osp
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="RAFT-TPU demo")
     p.add_argument("--model", required=True, help="checkpoint directory")
-    p.add_argument("--path", default="demo-frames",
-                   help="directory of frames (sorted, consecutive pairs)")
+    p.add_argument("--path", default=None,
+                   help="directory of frames (sorted, consecutive pairs); "
+                        "defaults to data_abel/ when present (the "
+                        "reference fork's signature sample, demo.py:69), "
+                        "else demo-frames/")
     p.add_argument("--out", default="demo-out", help="output directory")
     p.add_argument("--small", action="store_true")
     p.add_argument("--precision", default="bf16", choices=["bf16", "fp32"])
@@ -30,6 +33,8 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.path is None:
+        args.path = "data_abel" if osp.isdir("data_abel") else "demo-frames"
 
     import jax.numpy as jnp
     import numpy as np

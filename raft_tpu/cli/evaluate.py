@@ -25,6 +25,13 @@ def parse_args(argv=None):
                         "per-dataset values: 24/32/24)")
     p.add_argument("--data_root", default="datasets")
     p.add_argument("--chairs_split", default="chairs_split.txt")
+    p.add_argument("--eval_batch", type=int, default=4,
+                   help="images per jitted forward (streamed through one "
+                        "compiled bucket shape)")
+    p.add_argument("--no_bucket", action="store_true",
+                   help="KITTI: exact reference per-resolution padding "
+                        "(one XLA compile per distinct image shape) "
+                        "instead of one common bucket shape")
     return p.parse_args(argv)
 
 
@@ -72,13 +79,16 @@ def main(argv=None):
         evaluate.validate_chairs(
             variables, model_cfg, iters=iters,
             root=osp.join(args.data_root, "FlyingChairs_release/data"),
-            split_file=args.chairs_split)
+            split_file=args.chairs_split, batch_size=args.eval_batch)
     elif args.dataset == "sintel":
         evaluate.validate_sintel(variables, model_cfg, iters=iters,
-                                 root=osp.join(args.data_root, "Sintel"))
+                                 root=osp.join(args.data_root, "Sintel"),
+                                 batch_size=args.eval_batch)
     else:
         evaluate.validate_kitti(variables, model_cfg, iters=iters,
-                                root=osp.join(args.data_root, "KITTI"))
+                                root=osp.join(args.data_root, "KITTI"),
+                                batch_size=args.eval_batch,
+                                bucket=not args.no_bucket)
 
 
 if __name__ == "__main__":

@@ -34,7 +34,17 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=4e-4)
     p.add_argument("--num_steps", type=int, default=100000)
     p.add_argument("--batch_size", type=int, default=6,
-                   help="GLOBAL batch size (sharded over devices)")
+                   help="GLOBAL batch size (sharded over devices).  When "
+                        "it does not divide the device count it is rounded "
+                        "UP to the next multiple and the LR is scaled "
+                        "linearly (so the reference schedules run "
+                        "unmodified on any pod slice; see --batch_per_chip "
+                        "to pin the per-device batch instead)")
+    p.add_argument("--batch_per_chip", type=int, default=None,
+                   help="per-device batch size; overrides --batch_size "
+                        "(global = per_chip * device_count, no LR "
+                        "rescaling — tune --lr for the resulting global "
+                        "batch yourself)")
     p.add_argument("--image_size", type=int, nargs=2, default=[384, 512])
     p.add_argument("--precision", default="bf16", choices=["bf16", "fp32"])
     p.add_argument("--iters", type=int, default=12)
@@ -64,11 +74,42 @@ def parse_args(argv=None):
                    help="loader prefetch threads; 0 = min(16, cpu_count) "
                         "(the native augmentation kernels release the "
                         "GIL, so threads scale on multi-core pod hosts)")
+    p.add_argument("--shard_spatial", type=int, default=1, metavar="N",
+                   help="shard activations (image height) over N mesh "
+                        "devices in addition to data parallelism — for "
+                        "inputs whose all-pairs correlation volume "
+                        "exceeds one chip's HBM (720p+); device_count "
+                        "must be divisible by N")
     p.add_argument("--distributed", action="store_true",
                    help="multi-host pod run: call "
                         "jax.distributed.initialize() (auto-detects the "
                         "coordinator on TPU pods) before touching devices")
     return p.parse_args(argv)
+
+
+def resolve_batch(batch_size, batch_per_chip, num_devices, lr):
+    """Map the requested batch onto the device grid.
+
+    Returns ``(global_batch, lr)``.  ``batch_per_chip`` pins the
+    per-device batch (no LR rescale — the caller owns the tuning).
+    Otherwise a global ``batch_size`` that does not divide the mesh is
+    rounded UP to the next multiple of ``num_devices`` and the LR is
+    scaled linearly with the batch growth, so the reference's 2-GPU
+    global batches (10/6/6/6, /root/reference/train_standard.sh:3-6)
+    map onto any pod slice (e.g. v5e-64: 10 -> 64, lr x6.4) without
+    editing the scripts.
+    """
+    if batch_per_chip is not None:
+        if batch_per_chip <= 0:
+            raise ValueError(f"--batch_per_chip must be > 0, got "
+                             f"{batch_per_chip}")
+        return batch_per_chip * num_devices, lr
+    if batch_size <= 0:
+        raise ValueError(f"--batch_size must be > 0, got {batch_size}")
+    rounded = -(-batch_size // num_devices) * num_devices
+    if rounded != batch_size:
+        lr = lr * (rounded / batch_size)
+    return rounded, lr
 
 
 def main(argv=None):
@@ -99,21 +140,33 @@ def main(argv=None):
     mk = RAFTConfig.small_model if args.small else RAFTConfig.full
     model_cfg = mk(dropout=args.dropout, corr_impl=corr_impl,
                    compute_dtype=compute_dtype)
+    num_hosts = jax.process_count()
+    num_devices = jax.device_count()
+    batch_size, lr = resolve_batch(args.batch_size, args.batch_per_chip,
+                                   num_devices, args.lr)
+    if (batch_size, lr) != (args.batch_size, args.lr):
+        print(f"batch {args.batch_size} -> {batch_size} over "
+              f"{num_devices} devices"
+              + (f", lr {args.lr:g} -> {lr:g} (linear scaling)"
+                 if lr != args.lr else ""), flush=True)
+    if args.shard_spatial > 1:
+        if num_devices % args.shard_spatial:
+            raise SystemExit(f"--shard_spatial {args.shard_spatial} must "
+                             f"divide the {num_devices}-device mesh")
+        if args.image_size[0] % (8 * args.shard_spatial):
+            raise SystemExit(
+                f"--shard_spatial {args.shard_spatial} needs image height "
+                f"{args.image_size[0]} divisible by "
+                f"{8 * args.shard_spatial} (1/8-res rows split evenly)")
     cfg = TrainConfig(
         name=args.name, stage=args.stage, restore_ckpt=args.restore_ckpt,
-        validation=tuple(args.validation), lr=args.lr,
-        num_steps=args.num_steps, batch_size=args.batch_size,
+        validation=tuple(args.validation), lr=lr,
+        num_steps=args.num_steps, batch_size=batch_size,
         image_size=tuple(args.image_size), iters=args.iters,
         wdecay=args.wdecay, epsilon=args.epsilon, clip=args.clip,
         gamma=args.gamma, add_noise=args.add_noise, seed=args.seed,
         freeze_bn=args.stage != "chairs",  # reference train.py:147-148
         ckpt_dir=args.ckpt_dir)
-
-    num_hosts = jax.process_count()
-    num_devices = jax.device_count()
-    assert args.batch_size % num_devices == 0, (
-        f"global --batch_size {args.batch_size} must divide evenly over "
-        f"the {num_devices}-device data mesh axis")
     dataset = fetch_dataset(args.stage, tuple(args.image_size),
                             root=args.data_root,
                             split_file=args.chairs_split)
@@ -125,7 +178,7 @@ def main(argv=None):
     except AttributeError:  # non-Linux
         avail_cpus = os.cpu_count() or 4
     num_workers = args.num_workers or min(16, avail_cpus)
-    loader = ShardedLoader(dataset, args.batch_size // num_hosts,
+    loader = ShardedLoader(dataset, batch_size // num_hosts,
                            seed=args.seed, num_hosts=num_hosts,
                            host_id=jax.process_index(),
                            num_workers=num_workers)
@@ -160,9 +213,16 @@ def main(argv=None):
         for name in args.validation
     }
 
+    mesh = None
+    if args.shard_spatial > 1:
+        from raft_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(num_data=num_devices // args.shard_spatial,
+                         num_spatial=args.shard_spatial)
     train(model_cfg, cfg, loader=loader, validators=validators or None,
           restore_params=restore, tensorboard_dir=args.tensorboard_dir,
-          profile_dir=args.profile_dir)
+          profile_dir=args.profile_dir, mesh=mesh,
+          shard_spatial=args.shard_spatial > 1)
 
 
 if __name__ == "__main__":

@@ -132,12 +132,14 @@ class TrainConfig:
     val_freq: int = 5000
     log_freq: int = 100         # Logger SUM_FREQ, train.py:91
     freeze_bn: bool = False     # all stages but chairs, train.py:147-148
-    # Compute the sequence loss inside the refinement scan (per-iteration
-    # scalars) instead of stacking (iters, B, H, W, 2) flows to HBM.
-    # Numerically identical to the stacked path (tested) but measured ~7%
-    # SLOWER on v5e (the in-scan reductions bloat the remat backward), so
-    # the stacked path stays the default.
-    fused_loss: bool = False
+    # Compute the sequence loss inside the *upsample* scan, in
+    # space-to-depth layout (models/raft.py:UpsampleLossStep): the
+    # (iters, B, 8H, 8W, 2) stacked flows — and the pathological 6-D
+    # (.., 9, 8, 8) layouts of the direct convex-upsample einsum — never
+    # reach HBM.  Profiled round 2: the einsum formulation cost
+    # ~250 ms/step in HBM-bound relayout traffic.  fused_loss=False
+    # restores the stacked-flows path (same numerics, public-API shape).
+    fused_loss: bool = True
     ckpt_dir: str = "checkpoints"
     # Number of data-parallel shards (devices); resolved at runtime.
     num_devices: int = 0

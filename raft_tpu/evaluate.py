@@ -81,23 +81,74 @@ def _prep(sample: Dict[str, np.ndarray], mode: str):
     return image1, image2, padder
 
 
+def _peek_hw(path: str):
+    """Image (H, W) from the file header only (no pixel decode)."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        w, h = im.size
+    return h, w
+
+
+def _bucket_hw(ds) -> tuple:
+    """One /8-aligned bucket shape covering every image in the dataset.
+
+    KITTI's native resolutions vary per sequence (375x1242, 370x1224, ...)
+    so a per-shape jit would pay one XLA compile per distinct resolution
+    (minutes at every val_freq).  Padding everything to the max shape
+    compiles ONCE; edge-replicate padding repeats the border row, so
+    content inside the original frame sees the same receptive fields (the
+    residual effect is the instance-norm statistics over the slightly
+    larger canvas — sub-0.01 EPE, and the reference pays the same class of
+    artifact in its own right-padding, core/utils/utils.py:7-24)."""
+    hs, ws = zip(*(_peek_hw(p1) for (p1, _) in ds.image_list))
+    return (-(-max(hs) // 8) * 8, -(-max(ws) // 8) * 8)
+
+
+def _batched_flows(variables, eval_fn, ds, mode: str, batch_size: int,
+                   target=None):
+    """Stream the dataset through the jitted forward in fixed-shape
+    batches; yields ``(sample, flow (H, W, 2) np, unpadded)`` per image.
+
+    Every image is padded to ``target`` (or its own /8 shape — then all
+    images must share a resolution), so the whole pass costs ONE
+    compilation; the final partial batch is filled by repeating the last
+    image (discarded on yield)."""
+    n = len(ds)
+    for start in range(0, n, batch_size):
+        idxs = list(range(start, min(start + batch_size, n)))
+        samples = [ds.load(i) for i in idxs]
+        padders = [InputPadder(s["image1"].shape, mode=mode, target=target)
+                   for s in samples]
+        im1 = [p.pad_np(s["image1"]) for p, s in zip(padders, samples)]
+        im2 = [p.pad_np(s["image2"]) for p, s in zip(padders, samples)]
+        pad_n = batch_size - len(idxs)
+        if pad_n:  # keep the compiled batch shape on the final chunk
+            im1 += [im1[-1]] * pad_n
+            im2 += [im2[-1]] * pad_n
+        _, flow_up = eval_fn(variables, jnp.asarray(np.stack(im1)),
+                             jnp.asarray(np.stack(im2)))
+        flow_up = np.asarray(flow_up)
+        for j, (s, p) in enumerate(zip(samples, padders)):
+            yield s, np.asarray(p.unpad(flow_up[j:j + 1])[0])
+
+
 def validate_chairs(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
                     iters: int = 24,
                     root: str = "datasets/FlyingChairs_release/data",
                     split_file: str = "chairs_split.txt",
-                    eval_fn=None) -> Dict[str, float]:
-    """FlyingChairs validation-split EPE (reference evaluate.py:75-93)."""
+                    eval_fn=None, batch_size: int = 4) -> Dict[str, float]:
+    """FlyingChairs validation-split EPE (reference evaluate.py:75-93).
+
+    Images are a constant 384x512, so the whole split streams through one
+    compiled ``(batch_size, 384, 512)`` forward."""
     eval_fn = eval_fn or make_eval_fn(model_cfg, iters)
     ds = datasets.FlyingChairs(split="validation", root=root,
                                split_file=split_file)
     epe_list = []
-    for i in range(len(ds)):
-        sample = ds.load(i)
-        image1 = jnp.asarray(sample["image1"])[None]
-        image2 = jnp.asarray(sample["image2"])[None]
-        _, flow_up = eval_fn(variables, image1, image2)
-        epe = np.sqrt(np.sum(
-            (np.asarray(flow_up[0]) - sample["flow"]) ** 2, axis=-1))
+    for sample, flow in _batched_flows(variables, eval_fn, ds, "chairs",
+                                       batch_size):
+        epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
         epe_list.append(epe.reshape(-1))
     epe = float(np.mean(np.concatenate(epe_list)))
     print(f"Validation Chairs EPE: {epe:.3f}", flush=True)
@@ -106,18 +157,19 @@ def validate_chairs(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
 
 def validate_sintel(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
                     iters: int = 32, root: str = "datasets/Sintel",
-                    eval_fn=None) -> Dict[str, float]:
-    """Sintel training-split clean+final EPE (reference evaluate.py:96-128)."""
+                    eval_fn=None, batch_size: int = 2) -> Dict[str, float]:
+    """Sintel training-split clean+final EPE (reference evaluate.py:96-128).
+
+    All frames are 436x1024 -> one 440x1024 bucket, one compile per
+    dstype pass (same compiled shape for both)."""
     eval_fn = eval_fn or make_eval_fn(model_cfg, iters)
     results = {}
     for dstype in ("clean", "final"):
         ds = datasets.MpiSintel(split="training", dstype=dstype, root=root)
         epe_list = []
-        for i in range(len(ds)):
-            sample = ds.load(i)
-            image1, image2, padder = _prep(sample, "sintel")
-            _, flow_up = eval_fn(variables, image1, image2)
-            flow = np.asarray(padder.unpad(flow_up)[0])
+        for sample, flow in _batched_flows(variables, eval_fn, ds,
+                                           "sintel", batch_size,
+                                           target=_bucket_hw(ds)):
             epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
             epe_list.append(epe.reshape(-1))
         epe_all = np.concatenate(epe_list)
@@ -133,16 +185,21 @@ def validate_sintel(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
 
 def validate_kitti(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
                    iters: int = 24, root: str = "datasets/KITTI",
-                   eval_fn=None) -> Dict[str, float]:
-    """KITTI-15 training-split EPE + F1-all (reference evaluate.py:131-166)."""
+                   eval_fn=None, batch_size: int = 4,
+                   bucket: bool = True) -> Dict[str, float]:
+    """KITTI-15 training-split EPE + F1-all (reference evaluate.py:131-166).
+
+    ``bucket=True`` (default) pads every native resolution to one common
+    /8-aligned shape so the whole split costs ONE compile instead of one
+    per resolution (the every-5000-step validation cadence made per-shape
+    compiles the dominant wall-clock cost).  ``bucket=False`` restores the
+    reference's exact per-shape padding (per-image batches)."""
     eval_fn = eval_fn or make_eval_fn(model_cfg, iters)
     ds = datasets.KITTI(split="training", root=root)
+    target, bs = (_bucket_hw(ds), batch_size) if bucket else (None, 1)
     epe_list, out_list = [], []
-    for i in range(len(ds)):
-        sample = ds.load(i)
-        image1, image2, padder = _prep(sample, "kitti")
-        _, flow_up = eval_fn(variables, image1, image2)
-        flow = np.asarray(padder.unpad(flow_up)[0])
+    for sample, flow in _batched_flows(variables, eval_fn, ds, "kitti",
+                                       bs, target=target):
         epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
         mag = np.sqrt(np.sum(sample["flow"] ** 2, axis=-1))
         val = sample["valid"] >= 0.5

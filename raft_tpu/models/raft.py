@@ -55,7 +55,8 @@ from raft_tpu.ops.corr import (
     pool_fmap_pyramid,
 )
 from raft_tpu.ops.sampler import coords_grid, upflow8
-from raft_tpu.ops.upsample import convex_upsample
+from raft_tpu.ops.upsample import (convex_upsample, convex_upsample_flat,
+                                   space_to_depth_flow)
 
 
 class RefinementStep(nn.Module):
@@ -136,6 +137,50 @@ class UpsampleStep(nn.Module):
         return carry, flow_up
 
 
+class UpsampleLossStep(nn.Module):
+    """Mask head + FLAT convex upsample + masked L1/EPE partial sums.
+
+    The training-path replacement for :class:`UpsampleStep`: per-iteration
+    upsampled flows are produced in space-to-depth ``(c, p, q)`` channel
+    layout (:func:`convex_upsample_flat`) and compared against the
+    space-to-depth ground truth *inside the scan*, so the only per-
+    iteration outputs are five scalars — the full-resolution
+    ``(B, 8H, 8W, 2)`` tensors (280 MB/step stacked, plus their pathological
+    6-D layouts) never reach HBM.  Shares the ``mask_head`` parameter
+    scope with :class:`UpsampleStep` (same tree, checkpoint-compatible).
+
+    Inputs per scan step: ``net, flow`` with ``g`` iterations folded into
+    batch; broadcast: ``gt128 (B, H, W, 128)``, ``vmask64 (B, H, W, 64)``.
+    Emits ``(g, 5)``: ``[l1_sum, epe_sum, 1px_sum, 3px_sum, 5px_sum]``
+    per folded iteration (sums over masked elements; the caller
+    normalizes — reference loss semantics train.py:47-72).
+    """
+
+    config: RAFTConfig
+
+    @nn.compact
+    def __call__(self, carry, net, flow, gt128, vmask64):
+        cfg = self.config
+        B = gt128.shape[0]
+        g = net.shape[0] // B
+        mask = MaskHead(cfg.hidden_dim, cfg.dtype, name="mask_head")(net)
+        out = convex_upsample_flat(flow, mask)        # (gB, H, W, 128)
+        out = out.reshape((g, B) + out.shape[1:])
+        dx = out[..., :64] - gt128[None, ..., :64]
+        dy = out[..., 64:] - gt128[None, ..., 64:]
+        vm = vmask64[None]
+        l1 = jnp.sum(vm * (jnp.abs(dx) + jnp.abs(dy)), axis=(1, 2, 3, 4))
+        epe = jnp.sqrt(dx * dx + dy * dy)
+        sums = jnp.stack([
+            l1,
+            jnp.sum(vm * epe, axis=(1, 2, 3, 4)),
+            jnp.sum(vm * (epe < 1.0), axis=(1, 2, 3, 4)),
+            jnp.sum(vm * (epe < 3.0), axis=(1, 2, 3, 4)),
+            jnp.sum(vm * (epe < 5.0), axis=(1, 2, 3, 4)),
+        ], axis=-1)                                   # (g, 5)
+        return carry, sums
+
+
 class RAFT(nn.Module):
     """Full / small RAFT (reference core/raft.py:24-144)."""
 
@@ -148,10 +193,11 @@ class RAFT(nn.Module):
                  freeze_bn: bool = False,
                  loss_targets: Optional[tuple] = None):
         """``loss_targets``: optional ``(flow_gt (B,H,W,2), valid (B,H,W),
-        max_flow)`` — computes the per-iteration L1 terms in-model and
-        returns ``(per_iter_losses (iters,), last upsampled flow)``
-        instead of stacked flows (the γ-weighting is applied by the
-        caller)."""
+        max_flow)`` — computes the per-iteration L1 terms in-model (in
+        space-to-depth layout; the full-res per-iteration flows never
+        reach HBM) and returns ``(per_iter_losses (iters,), metrics
+        dict)`` instead of stacked flows (the γ-weighting is applied by
+        the caller)."""
         cfg = self.config
         dt = cfg.dtype
         hdim, cdim = cfg.hidden_dim, cfg.context_dim
@@ -252,6 +298,41 @@ class RAFT(nn.Module):
         # convs + a softmax per group.
         I = iters
         g = next((g for g in (2, 1) if I % g == 0))
+        nets_r = nets.reshape((I // g, g * B) + nets.shape[2:])
+        flows_r = flows.reshape((I // g, g * B) + flows.shape[2:])
+
+        if loss_targets is not None:
+            # Sequence loss fused into the upsample scan: the full-res
+            # per-iteration flows never reach HBM (see UpsampleLossStep).
+            from raft_tpu.train.loss import combined_valid
+
+            flow_gt, valid, max_flow = loss_targets
+            vmask = combined_valid(flow_gt, valid, max_flow)
+            gt128 = space_to_depth_flow(flow_gt.astype(jnp.float32))
+            vmask64 = space_to_depth_flow(vmask[..., None])
+            up_step = UpsampleLossStep
+            if cfg.remat_upsample:
+                up_step = nn.remat(UpsampleLossStep)
+            up_scan = nn.scan(
+                up_step,
+                variable_broadcast="params",
+                split_rngs={"params": False, "dropout": True},
+                in_axes=(0, 0, nn.broadcast, nn.broadcast),
+                out_axes=0,
+                length=I // g,
+            )(cfg, name="upsampler")
+            _, sums = up_scan(None, nets_r, flows_r, gt128, vmask64)
+            sums = sums.reshape(I, 5)
+            _, H8s, W8s, _ = gt128.shape
+            n_all = B * H8s * W8s * 128          # loss mean incl. zeroed
+            n_valid = jnp.maximum(jnp.sum(vmask64), 1.0)
+            per_iter = sums[:, 0] / n_all
+            metrics = {"epe": sums[-1, 1] / n_valid,
+                       "1px": sums[-1, 2] / n_valid,
+                       "3px": sums[-1, 3] / n_valid,
+                       "5px": sums[-1, 4] / n_valid}
+            return per_iter, metrics
+
         up_step = UpsampleStep
         if cfg.remat_upsample:
             up_step = nn.remat(UpsampleStep)
@@ -263,20 +344,8 @@ class RAFT(nn.Module):
             out_axes=0,
             length=I // g,
         )(cfg, name="upsampler")
-        _, flow_ups = up_scan(
-            None, nets.reshape((I // g, g * B) + nets.shape[2:]),
-            flows.reshape((I // g, g * B) + flows.shape[2:]))
+        _, flow_ups = up_scan(None, nets_r, flows_r)
         flow_ups = flow_ups.reshape((I, B) + flow_ups.shape[2:])
-
-        if loss_targets is not None:
-            from raft_tpu.train.loss import combined_valid
-
-            flow_gt, valid, max_flow = loss_targets
-            vmask = combined_valid(flow_gt, valid, max_flow)
-            abs_err = jnp.abs(flow_ups - flow_gt[None].astype(jnp.float32))
-            per_iter = jnp.mean(vmask[None, ..., None] * abs_err,
-                                axis=(1, 2, 3, 4))
-            return per_iter, flow_ups[-1]
         return flow_ups
 
     def _small_outputs(self, flows, flow_low, test_mode, loss_targets):
@@ -289,7 +358,7 @@ class RAFT(nn.Module):
             up = upflow8(flows.reshape(I * B, H8, W8, 2))
             return up.reshape(I, B, H8 * 8, W8 * 8, 2)
 
-        from raft_tpu.train.loss import combined_valid
+        from raft_tpu.train.loss import combined_valid, flow_metrics
 
         flow_gt, valid, max_flow = loss_targets
         vmask = combined_valid(flow_gt, valid, max_flow)
@@ -301,4 +370,4 @@ class RAFT(nn.Module):
 
         last_flow, per_iter = jax.lax.scan(
             body, jnp.zeros(flow_gt.shape, jnp.float32), flows)
-        return per_iter, last_flow
+        return per_iter, flow_metrics(last_flow, flow_gt, vmask)

@@ -3,21 +3,37 @@
 The model downsamples by 8, so H and W must be divisible by 8.  'sintel'
 mode centers the height padding; every other mode puts all height padding at
 the bottom.  Width padding is always centered.  Padding is edge-replicate.
+
+``target=(H, W)`` pads up to a fixed bucket shape instead of the next
+multiple of 8 — the batched-evaluation path pads every KITTI resolution to
+one common shape so the jitted forward compiles once (the placement policy
+of the mode is preserved, and edge-replicate rows are identical however
+many there are).
 """
 
 from __future__ import annotations
+
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 
 class InputPadder:
-    """Pads NHWC images so H, W are divisible by 8; unpads flow back."""
+    """Pads NHWC images so H, W are divisible by 8 (or match ``target``);
+    unpads flow back."""
 
-    def __init__(self, dims, mode: str = "sintel"):
+    def __init__(self, dims, mode: str = "sintel",
+                 target: Optional[Tuple[int, int]] = None):
         self.ht, self.wd = dims[-3:-1] if len(dims) >= 3 else dims
-        pad_ht = (((self.ht // 8) + 1) * 8 - self.ht) % 8
-        pad_wd = (((self.wd // 8) + 1) * 8 - self.wd) % 8
+        if target is None:
+            pad_ht = (((self.ht // 8) + 1) * 8 - self.ht) % 8
+            pad_wd = (((self.wd // 8) + 1) * 8 - self.wd) % 8
+        else:
+            pad_ht, pad_wd = target[0] - self.ht, target[1] - self.wd
+            assert pad_ht >= 0 and pad_wd >= 0, (
+                f"target {target} smaller than image "
+                f"({self.ht}, {self.wd})")
         if mode == "sintel":
             self._pad = [pad_wd // 2, pad_wd - pad_wd // 2,
                          pad_ht // 2, pad_ht - pad_ht // 2]
@@ -27,6 +43,14 @@ class InputPadder:
     def pad(self, *inputs):
         l, r, t, b = self._pad
         out = [jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)), mode="edge")
+               for x in inputs]
+        return out if len(out) > 1 else out[0]
+
+    def pad_np(self, *inputs):
+        """Host-side variant: ``(H, W, C)`` numpy arrays, for assembling
+        batched eval inputs without a device round-trip per image."""
+        l, r, t, b = self._pad
+        out = [np.pad(x, ((t, b), (l, r), (0, 0)), mode="edge")
                for x in inputs]
         return out if len(out) > 1 else out[0]
 

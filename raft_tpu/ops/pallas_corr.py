@@ -465,6 +465,12 @@ def _pyr_fwd(pyramid, coords, radius, block_q, interpret):
     B, H1, W1, _ = coords.shape
     N = H1 * W1
     Npad = pyramid[0].shape[1]
+    if Npad % block_q:
+        raise ValueError(
+            f"pyramid query dim {Npad} is not a multiple of block_q "
+            f"{block_q}; build the pyramid with "
+            f"build_corr_pyramid_flat(..., pad_q={block_q}) — a mismatch "
+            "would silently skip trailing query rows in the Pallas grid")
     k = 2 * radius + 1
     c = _pad_coords_oor(coords.reshape(B, N, 2).astype(jnp.float32), Npad)
     outs = []
@@ -487,6 +493,11 @@ def _pyr_bwd(radius, block_q, interpret, residuals, g):
     B, H1, W1, _ = coords.shape
     N = H1 * W1
     Npad = shapes[0][1]
+    if Npad % block_q:
+        raise ValueError(
+            f"pyramid query dim {Npad} is not a multiple of block_q "
+            f"{block_q}; build the pyramid with "
+            f"build_corr_pyramid_flat(..., pad_q={block_q})")
     k = 2 * radius + 1
     c = _pad_coords_oor(coords.reshape(B, N, 2).astype(jnp.float32), Npad)
     g = g.reshape(B, N, -1).astype(jnp.float32)

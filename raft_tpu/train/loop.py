@@ -50,7 +50,7 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
           restore_params=None,
           tensorboard_dir: Optional[str] = None,
           profile_dir: Optional[str] = None,
-          mesh=None) -> TrainState:
+          mesh=None, shard_spatial: bool = False) -> TrainState:
     """Run the full training loop.
 
     ``batches``: iterator of host batches (dicts of NHWC numpy arrays).
@@ -61,6 +61,10 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
     steps (reference train.py:190-196).
     ``restore_params``: optional {'params', 'batch_stats'} to seed from a
     previous curriculum stage (reference --restore_ckpt, train.py:141-142).
+    ``shard_spatial``: additionally shard image height over the mesh's
+    ``spatial`` axis (pass a mesh built with ``num_spatial > 1``) — the
+    activation/corr-volume sharding path for inputs too large for one
+    chip's HBM.
     """
     assert (batches is None) != (loader is None), \
         "pass exactly one of batches= or loader="
@@ -84,7 +88,8 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
         state = resumed
         print(f"resumed from step {int(state.step)}", flush=True)
 
-    step_fn = make_train_step(model, tx, cfg, mesh)
+    step_fn = make_train_step(model, tx, cfg, mesh,
+                              shard_spatial=shard_spatial)
     logger = Logger(cfg.log_freq, lr_fn=schedule_of(cfg.lr, cfg.num_steps),
                     tensorboard_dir=tensorboard_dir)
     key = jax.random.PRNGKey(cfg.seed)
@@ -105,7 +110,8 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             batch = add_image_noise(noise_rng, batch)
         profiler.maybe_start(step)
         with annotate_step(step):
-            state, metrics = step_fn(state, shard_batch(batch, mesh), key)
+            state, metrics = step_fn(
+                state, shard_batch(batch, mesh, spatial=shard_spatial), key)
         profiler.maybe_stop(step, sync_on=metrics.get("loss"))
         step += 1
         logger.push(step - 1, metrics)

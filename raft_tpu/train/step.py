@@ -23,8 +23,7 @@ from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.models.raft import RAFT
 from raft_tpu.parallel.mesh import (batch_sharding, replicated_sharding,
                                     spatial_batch_sharding)
-from raft_tpu.train.loss import (combined_valid, flow_metrics,
-                                 sequence_loss)
+from raft_tpu.train.loss import sequence_loss
 from raft_tpu.train.state import TrainState
 
 
@@ -75,14 +74,10 @@ def make_train_step(model: RAFT, tx: optax.GradientTransformation,
                           **kwargs)
         out, new_vars = out if mutable else (out, {})
         if cfg.fused_loss:
-            per_iter, last_flow = out
+            per_iter, metrics = out
             i = jnp.arange(cfg.iters, dtype=per_iter.dtype)
             weights = cfg.gamma ** (cfg.iters - i - 1.0)
             loss = jnp.sum(weights * per_iter)
-            metrics = flow_metrics(
-                last_flow, batch["flow"],
-                combined_valid(batch["flow"], batch["valid"],
-                               cfg.max_flow))
         else:
             loss, metrics = sequence_loss(
                 out, batch["flow"], batch["valid"],

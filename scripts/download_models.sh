@@ -1,0 +1,25 @@
+#!/bin/bash
+# Fetch the published RAFT model zoo and convert every checkpoint to this
+# framework's orbax format (the reference ships raw .pth files,
+# /root/reference/download_models.sh:2-3 — same zip, plus the torch->flax
+# conversion step the reference doesn't need).
+#
+# Requires network access.  See docs/REAL_WEIGHTS_RUNBOOK.md for the full
+# first-network-access validation sequence (convert -> demo -> Sintel EPE).
+set -e
+cd "$(dirname "$0")/.."
+
+if [ ! -f models.zip ]; then
+    wget https://www.dropbox.com/s/4j4z58wuv8o0mfz/models.zip
+fi
+unzip -o models.zip   # -> models/raft-{things,sintel,kitti,chairs,small}.pth
+
+mkdir -p checkpoints
+for pth in models/*.pth; do
+    name=$(basename "$pth" .pth)
+    small=""
+    [ "$name" = "raft-small" ] && small="--small"
+    echo "converting $name ..."
+    python -m raft_tpu.convert "$pth" "checkpoints/$name" $small
+done
+echo "done: $(ls checkpoints)"

@@ -1,0 +1,167 @@
+"""Capture an op-level XProf profile of the bench training step.
+
+Round-1 tuning worked from whole-step ablations only; this script closes
+that gap: it runs the exact bench.py training configuration under a
+``jax.profiler`` trace and converts the captured xplane with the local
+``xprof`` package into per-HLO-op statistics (no TensorBoard UI needed —
+this box is headless).
+
+Usage:
+    python scripts/profile_step.py [outdir]
+Env: same knobs as bench.py (BENCH_BATCH, BENCH_IMAGE, BENCH_CORR_IMPL...).
+
+Outputs in <outdir> (default /tmp/raft_prof):
+    hlo_stats.json      per-op table (category, self time, FLOP rate)
+    op_profile.json     xprof op_profile tree
+    summary.txt         top self-time ops + per-category rollup
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def capture(outdir: str) -> str:
+    import jax
+    import numpy as np
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.parallel.mesh import make_mesh, shard_batch
+    from raft_tpu.train.optim import make_optimizer
+    from raft_tpu.train.step import init_state, make_train_step
+
+    n_dev = jax.device_count()
+    mesh = make_mesh(num_data=n_dev, num_spatial=1)
+    H, W = (int(x) for x in
+            os.environ.get("BENCH_IMAGE", "368x496").split("x"))
+    B = int(os.environ.get("BENCH_BATCH", 16)) * n_dev
+    _d = RAFTConfig()
+    model_cfg = RAFTConfig.full(
+        compute_dtype=os.environ.get("BENCH_COMPUTE_DTYPE", "bfloat16"),
+        corr_impl=os.environ.get("BENCH_CORR_IMPL", "allpairs_pallas"),
+        corr_precision=os.environ.get("BENCH_CORR_PRECISION", "highest"),
+        remat=os.environ.get("BENCH_REMAT", "1") == "1",
+        remat_policy=os.environ.get("BENCH_REMAT_POLICY", _d.remat_policy),
+        scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", _d.scan_unroll)),
+        remat_upsample=os.environ.get("BENCH_REMAT_UPSAMPLE", "1") == "1")
+    cfg = TrainConfig(num_steps=1000, batch_size=B, image_size=(H, W),
+                      iters=12)
+
+    model = RAFT(model_cfg)
+    tx = make_optimizer(cfg.lr, cfg.num_steps, cfg.wdecay, cfg.epsilon,
+                        cfg.clip)
+    state = init_state(model, tx, jax.random.PRNGKey(0), (H, W))
+    step_fn = make_train_step(model, tx, cfg, mesh)
+
+    rng = np.random.default_rng(0)
+    batch = shard_batch({
+        "image1": rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32),
+        "image2": rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32),
+        "flow": (8.0 * rng.standard_normal((B, H, W, 2))).astype(np.float32),
+        "valid": np.ones((B, H, W), np.float32),
+    }, mesh)
+    key = jax.random.PRNGKey(1)
+
+    for _ in range(3):
+        state, metrics = step_fn(state, batch, key)
+    float(metrics["loss"])
+
+    jax.profiler.start_trace(outdir)
+    for _ in range(3):
+        state, metrics = step_fn(state, batch, key)
+    float(metrics["loss"])  # hard sync before stopping the trace
+    jax.profiler.stop_trace()
+
+    paths = glob.glob(os.path.join(outdir, "plugins/profile/*/*.xplane.pb"))
+    if not paths:
+        raise RuntimeError(f"no xplane.pb under {outdir}")
+    return max(paths, key=os.path.getmtime)
+
+
+def convert(xplane: str, outdir: str) -> None:
+    from xprof.convert import raw_to_tool_data as rtd
+
+    for tool in ("hlo_stats", "op_profile"):
+        try:
+            data = rtd.xspace_to_tool_data([xplane], tool, {})
+            if isinstance(data, tuple):
+                data = data[0]
+            out = os.path.join(outdir, f"{tool}.json")
+            mode = "wb" if isinstance(data, bytes) else "w"
+            with open(out, mode) as f:
+                f.write(data)
+            print(f"wrote {out}")
+        except Exception as e:  # tool coverage varies by xprof version
+            print(f"{tool} conversion failed: {e!r}")
+
+
+def summarize(outdir: str) -> None:
+    path = os.path.join(outdir, "hlo_stats.json")
+    if not os.path.exists(path):
+        return
+    with open(path) as f:
+        raw = f.read()
+    data = json.loads(raw)
+    # hlo_stats is a GViz table: {cols: [...], rows: [{c: [{v: ...}]}]}
+    if isinstance(data, list):
+        data = data[0]
+    cols = [c.get("label") or c.get("id") for c in data["cols"]]
+    rows = [[cell.get("v") if isinstance(cell, dict) else cell
+             for cell in r["c"]] for r in data["rows"]]
+
+    def col(name_frag):
+        for i, c in enumerate(cols):
+            if c and name_frag.lower() in str(c).lower():
+                return i
+        return None
+
+    i_cat = col("category")
+    i_name = col("HLO op name") or col("op name")
+    i_self = col("Total self time (us)") or col("self time")
+    i_prog = col("program")
+    lines = [f"columns: {cols}", ""]
+
+    by_cat = {}
+    for r in rows:
+        cat = r[i_cat] if i_cat is not None else "?"
+        t = float(r[i_self] or 0) if i_self is not None else 0.0
+        by_cat[cat] = by_cat.get(cat, 0.0) + t
+    total = sum(by_cat.values())
+    lines.append(f"== per-category self time (total {total/1e3:.1f} ms "
+                 "across traced steps) ==")
+    for cat, t in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        lines.append(f"  {t/1e3:9.2f} ms  {100*t/max(total,1e-9):5.1f}%  {cat}")
+
+    lines.append("")
+    lines.append("== top 60 ops by self time ==")
+    rows.sort(key=lambda r: -(float(r[i_self] or 0)
+                              if i_self is not None else 0))
+    for r in rows[:60]:
+        t = float(r[i_self] or 0) / 1e3
+        name = str(r[i_name])[:140] if i_name is not None else "?"
+        cat = r[i_cat] if i_cat is not None else "?"
+        prog = (str(r[i_prog])[:20] if i_prog is not None else "")
+        lines.append(f"  {t:9.2f} ms  [{cat}] {prog} {name}")
+
+    out = "\n".join(lines)
+    with open(os.path.join(outdir, "summary.txt"), "w") as f:
+        f.write(out + "\n")
+    print(out)
+
+
+if __name__ == "__main__":
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/raft_prof"
+    os.makedirs(outdir, exist_ok=True)
+    t0 = time.time()
+    xplane = capture(outdir)
+    print(f"captured {xplane} in {time.time()-t0:.0f}s")
+    convert(xplane, outdir)
+    summarize(outdir)

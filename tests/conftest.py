@@ -18,3 +18,22 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: end-to-end / oracle tests (full-suite tier; minutes on "
+        "1 CPU)")
+    config.addinivalue_line(
+        "markers",
+        "fast: auto-applied to everything not marked slow — "
+        "`pytest -m fast` is the per-commit gate (<2 min on 1 CPU)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.fast)

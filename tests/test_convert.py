@@ -14,6 +14,8 @@ from raft_tpu.models.raft import RAFT
 
 from reference_oracle import load_reference_core, skip_without_reference
 
+pytestmark = pytest.mark.slow
+
 # H/8 must stay >= 2^(levels-1)+1: the reference's align_corners grid_sample
 # divides by (size-1), so a 1-pixel top pyramid level NaNs the oracle.
 H, W = 128, 160

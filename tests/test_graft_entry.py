@@ -3,6 +3,10 @@
 devices — if these break, the whole round's validation fails."""
 
 import numpy as np
+import pytest
+
+
+pytestmark = pytest.mark.slow
 
 
 def test_entry_shapes():

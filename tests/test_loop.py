@@ -10,6 +10,8 @@ from raft_tpu.train import init_state, make_optimizer
 from raft_tpu.train.checkpoint import CheckpointManager
 from raft_tpu.train.loop import add_image_noise, train
 
+pytestmark = pytest.mark.slow
+
 
 def _batches(n, tcfg, seed=0):
     rng = np.random.default_rng(seed)

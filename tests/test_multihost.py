@@ -1,0 +1,49 @@
+"""Two-process ``jax.distributed`` test of the multi-host input path.
+
+Round-1 gap: ``shard_batch``'s ``make_array_from_process_local_data``
+branch (parallel/mesh.py) and the pod init flow only ever ran with
+``process_count() == 1``.  Here two real OS processes form a distributed
+CPU "pod" (2 virtual devices each, 4 global) and verify the global batch
+assembly — the analog of the reference's DistributedSampler feeding
+DistributedDataParallel ranks.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_shard_batch():
+    port = _free_port()
+    child = os.path.join(os.path.dirname(__file__), "_multihost_child.py")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(child)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    env.pop("XLA_FLAGS", None)  # child sets its own device count (2)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, child, str(port), str(i), "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=repo)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed child timed out:\n" + "\n".join(outs))
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        assert "OK" in out, out

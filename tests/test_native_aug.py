@@ -43,6 +43,9 @@ def _fallback(fn, *args, **kw):
     (0.7, 0.9, False, False),
     (1.4, 1.2, True, False),
     (2.0, 0.6, False, True),
+    # Non-exact scales (160*0.73 = 116.8, 120*1.17 = 140.4): pins the
+    # cvRound-based rh/rw rounding contract directly against cv2.
+    (0.73, 1.17, True, False),
 ])
 def test_warp_u8_matches_cv2(sx, sy, hflip, vflip):
     import cv2

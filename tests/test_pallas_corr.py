@@ -15,6 +15,8 @@ from raft_tpu.ops.corr import (build_corr_pyramid, chunked_corr_lookup,
 from raft_tpu.ops.pallas_corr import pallas_corr_lookup
 from raft_tpu.ops.sampler import coords_grid
 
+pytestmark = pytest.mark.slow
+
 B, H, W, C = 2, 12, 16, 32
 LEVELS, RADIUS = 3, 3
 

@@ -14,6 +14,8 @@ from raft_tpu.parallel.mesh import make_mesh, shard_batch
 from raft_tpu.train.optim import make_optimizer
 from raft_tpu.train.step import init_state, make_train_step
 
+pytestmark = pytest.mark.slow
+
 H, W, B = 48, 64, 4
 
 

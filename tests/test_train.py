@@ -12,6 +12,8 @@ from raft_tpu.parallel import make_mesh, shard_batch
 from raft_tpu.train import (TrainState, init_state, make_optimizer,
                             make_train_step, onecycle_lr, sequence_loss)
 
+pytestmark = pytest.mark.slow
+
 
 def test_sequence_loss_matches_reference():
     """Our vectorized sequence loss vs the reference's list-based one
@@ -206,4 +208,51 @@ def test_fused_loss_matches_stacked():
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        st_f.params, st_s.params)
+
+
+def test_fused_loss_matches_stacked_full_model():
+    """Full-model variant: the space-to-depth UpsampleLossStep path vs
+    sequence_loss over stacked full-res flows (same multiset of masked L1
+    terms, different reduction order)."""
+    import dataclasses
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.train.step import make_train_step, init_state
+
+    H, W, B = 48, 64, 2
+    mcfg = RAFTConfig.full()
+    model = RAFT(mcfg)
+    tcfg = TrainConfig(num_steps=10, batch_size=B, image_size=(H, W),
+                       iters=2, fused_loss=True)
+    tx = make_optimizer(tcfg.lr, tcfg.num_steps, tcfg.wdecay,
+                        tcfg.epsilon, tcfg.clip)
+    state = init_state(model, tx, jax.random.PRNGKey(0), (H, W))
+    rng = np.random.default_rng(3)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)),
+                              jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)),
+                              jnp.float32),
+        "flow": jnp.asarray(rng.standard_normal((B, H, W, 2)),
+                            jnp.float32),
+        # exercise the valid mask too
+        "valid": jnp.asarray((rng.uniform(size=(B, H, W)) > 0.1)
+                             .astype(np.float32)),
+    }
+    key = jax.random.PRNGKey(1)
+
+    st_f, m_f = make_train_step(model, tx, tcfg, donate=False)(
+        state, batch, key)
+    st_s, m_s = make_train_step(
+        model, tx, dataclasses.replace(tcfg, fused_loss=False),
+        donate=False)(state, batch, key)
+
+    for k in ("loss", "epe", "1px", "3px", "5px", "grad_norm"):
+        np.testing.assert_allclose(float(m_f[k]), float(m_s[k]),
+                                   rtol=1e-4, err_msg=k)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5),
         st_f.params, st_s.params)

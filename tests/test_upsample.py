@@ -46,3 +46,50 @@ def test_vs_reference_upsample_flow():
 
     got = np.asarray(convex_upsample(jnp.asarray(flow), jnp.asarray(mask)))
     np.testing.assert_allclose(got, expected, atol=1e-4)
+
+
+def test_flat_upsample_matches_einsum():
+    """convex_upsample_flat (the TPU-layout training path) must reproduce
+    convex_upsample exactly up to fp32 reduction order, through the
+    space-to-depth inverse."""
+    from raft_tpu.ops.upsample import (convex_upsample_flat,
+                                       depth_to_space_flow)
+
+    rng = np.random.default_rng(1)
+    flow = rng.normal(scale=3, size=(2, 5, 7, 2)).astype(np.float32)
+    mask = rng.normal(scale=2, size=(2, 5, 7, 9 * 64)).astype(np.float32)
+    want = np.asarray(convex_upsample(jnp.asarray(flow), jnp.asarray(mask)))
+    flat = convex_upsample_flat(jnp.asarray(flow), jnp.asarray(mask))
+    assert flat.shape == (2, 5, 7, 128)
+    got = np.asarray(depth_to_space_flow(flat))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_space_to_depth_roundtrip_and_layout():
+    from raft_tpu.ops.upsample import (depth_to_space_flow,
+                                       space_to_depth_flow)
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(3, 16, 24, 2)).astype(np.float32)
+    packed = np.asarray(space_to_depth_flow(jnp.asarray(x)))
+    assert packed.shape == (3, 2, 3, 128)
+    # channel order (c, p, q)
+    assert packed[1, 0, 1, 0 * 64 + 3 * 8 + 5] == x[1, 3, 8 + 5, 0]
+    assert packed[1, 1, 2, 1 * 64 + 2 * 8 + 7] == x[1, 8 + 2, 16 + 7, 1]
+    back = np.asarray(depth_to_space_flow(jnp.asarray(packed)))
+    np.testing.assert_array_equal(back, x)
+
+
+def test_flat_upsample_extreme_logits_stable():
+    """A tap group sitting hundreds of logits below the pixel's hottest
+    group must not underflow its softmax denominator (per-group max
+    subtraction, not per-pixel global max)."""
+    from raft_tpu.ops.upsample import convex_upsample_flat
+
+    flow = np.ones((1, 2, 2, 2), np.float32)
+    mask = np.zeros((1, 2, 2, 9 * 64), np.float32)
+    mask[..., 0:64] = 500.0       # tap 0 dominates subpixel group 0..63
+    mask[..., 64 + 1] = -400.0    # another group far below, mixed scale
+    out = np.asarray(convex_upsample_flat(jnp.asarray(flow),
+                                          jnp.asarray(mask)))
+    assert np.isfinite(out).all()
