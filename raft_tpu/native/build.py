@@ -46,7 +46,8 @@ def load() -> Optional[ctypes.CDLL]:
                 srcs = [os.path.join(_DIR, s) for s in _SOURCES]
                 tmp = _SO + f".tmp.{os.getpid()}"
                 subprocess.run(
-                    ["cc", "-O3", "-shared", "-fPIC", "-o", tmp, *srcs],
+                    ["cc", "-O3", "-shared", "-fPIC", "-o", tmp, *srcs,
+                     "-lm"],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, _SO)  # atomic wrt concurrent workers
             lib = ctypes.CDLL(_SO)
@@ -66,6 +67,18 @@ def load() -> Optional[ctypes.CDLL]:
             lib.aug_saturation.restype = None
             lib.aug_saturation.argtypes = [
                 ctypes.c_void_p, ctypes.c_long, ctypes.c_float]
+            lib.aug_hue_shift.restype = None
+            lib.aug_hue_shift.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_int]
+            lib.aug_channel_sums.restype = None
+            lib.aug_channel_sums.argtypes = [
+                ctypes.c_void_p, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_double)]
+            lib.aug_fill_rect.restype = None
+            lib.aug_fill_rect.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_ubyte,
+                ctypes.c_ubyte, ctypes.c_ubyte]
             _warp_common = [
                 ctypes.c_void_p, ctypes.c_long, ctypes.c_long,
                 ctypes.c_long, ctypes.c_void_p, ctypes.c_long,

@@ -159,3 +159,42 @@ def test_dense_augmentor_exact_crop_size():
         assert o1.shape == (96, 128, 3) and of.shape == (96, 128, 2)
         hit_noresize += 1  # shape check suffices; crash was the bug
     assert hit_noresize == 40
+
+
+def test_hue_shift_matches_cv2():
+    """Native fused RGB->HSV->shift->RGB vs the cv2 two-step path: the
+    fixed-point forward is exact; the back-conversion is within one level
+    everywhere (cv2 4.x's u8 HSV2RGB uses a SIMD fixed-point path whose
+    per-value rounding is not reproducible by any single trunc/round rule
+    — verified contradictory cases — so ±1 on a minority of pixels is the
+    contract, same as the other photometric ops)."""
+    import cv2
+
+    lib = load()
+    rng = np.random.default_rng(5)
+    img = rng.integers(0, 255, (80, 120, 3), dtype=np.uint8)
+    for shift in (-0.12, 0.0, 0.07, 0.159):
+        got = np.array(img)
+        lib.aug_hue_shift(got.ctypes.data, got.size // 3,
+                          int(round(shift * 180.0)))
+        hsv = cv2.cvtColor(img, cv2.COLOR_RGB2HSV)
+        h = (hsv[..., 0].astype(np.int16) + int(round(shift * 180.0))) % 180
+        hsv[..., 0] = h.astype(np.uint8)
+        want = cv2.cvtColor(hsv, cv2.COLOR_HSV2RGB)
+        d = np.abs(got.astype(np.int16) - want.astype(np.int16))
+        assert d.max() <= 1 and (d > 0).mean() < 0.15
+
+
+def test_eraser_matches_numpy():
+    """Native channel-sum + clipped rect fill vs the numpy eraser under
+    identical RNG streams (same draws, same truncating mean cast)."""
+    from raft_tpu.data.augment import FlowAugmentor
+
+    img1, img2, _ = _rand_imgs(seed=7)
+    aug = FlowAugmentor(crop_size=(64, 96), eraser_aug_prob=1.0)
+    _, got = aug.eraser_transform(np.random.default_rng(3), img1, img2)
+    _, want = _fallback(aug.eraser_transform, np.random.default_rng(3),
+                        img1, img2)
+    d = np.abs(got.astype(np.int16) - want.astype(np.int16))
+    assert d.max() <= 1  # float64 sum order can flip the truncated mean
+    assert (d > 0).mean() < 0.5
