@@ -230,6 +230,12 @@ class FlowAugmentor:
     def eraser_transform(self, rng, img1, img2, bounds=(50, 100)):
         ht, wd = img1.shape[:2]
         if rng.random() < self.eraser_aug_prob:
+            # ONE draw sequence for both backends — the native/NumPy seed
+            # portability contract hangs on identical RNG consumption.
+            rects = [(int(rng.integers(0, wd)), int(rng.integers(0, ht)),
+                      int(rng.integers(bounds[0], bounds[1])),
+                      int(rng.integers(bounds[0], bounds[1])))
+                     for _ in range(rng.integers(1, 3))]
             lib = _nlib()
             if lib is not None:
                 img2 = _native_buf(img2, inplace=False)
@@ -239,21 +245,13 @@ class FlowAugmentor:
                 # numpy's float64 mean assigned into a uint8 array
                 # truncates; replicate that cast exactly
                 mc = [int(s / n_px) for s in sums]
-                for _ in range(rng.integers(1, 3)):
-                    x0 = int(rng.integers(0, wd))
-                    y0 = int(rng.integers(0, ht))
-                    dx = int(rng.integers(bounds[0], bounds[1]))
-                    dy = int(rng.integers(bounds[0], bounds[1]))
+                for x0, y0, dx, dy in rects:
                     lib.aug_fill_rect(img2.ctypes.data, ht, wd, y0, x0,
                                       dy, dx, mc[0], mc[1], mc[2])
                 return img1, img2
             img2 = img2.copy()
             mean_color = img2.reshape(-1, 3).mean(axis=0)
-            for _ in range(rng.integers(1, 3)):
-                x0 = int(rng.integers(0, wd))
-                y0 = int(rng.integers(0, ht))
-                dx = int(rng.integers(bounds[0], bounds[1]))
-                dy = int(rng.integers(bounds[0], bounds[1]))
+            for x0, y0, dx, dy in rects:
                 img2[y0:y0 + dy, x0:x0 + dx, :] = mean_color
         return img1, img2
 

@@ -313,12 +313,13 @@ def _auto_interpret() -> bool:
 _ROW_TILE = 8
 
 
-def _pyr_fwd_kernel(corr_ref, c_ref, out_ref, *, hl, wl, k, lvl_div):
-    """corr_ref: (1, BQ, hl, wl); c_ref: (1, BQ, 2); out: (1, BQ, k*k).
-    Queries live in sublanes; x in lanes."""
+def _pyr_fwd_level_body(corr_ref, c_ref, out_ref, lvl, out_off, hl, wl, k):
+    """One level's forward sampling inside the fused kernel: write
+    ``(BQ, k*k)`` taps at lane offset ``out_off`` of ``out_ref``."""
     bq = corr_ref.shape[1]
     r = (k - 1) // 2
-    cx = c_ref[0, :, 0:1] * lvl_div      # (BQ, 1)
+    lvl_div = 1.0 / (2.0 ** lvl)
+    cx = c_ref[0, :, 0:1] * lvl_div
     cy = c_ref[0, :, 1:2] * lvl_div
     posx = jax.lax.broadcasted_iota(jnp.int32, (bq, wl), 1) \
         .astype(jnp.float32)
@@ -328,7 +329,7 @@ def _pyr_fwd_kernel(corr_ref, c_ref, out_ref, *, hl, wl, k, lvl_div):
     nt = hl // T
 
     def tile_body(t, accs):
-        blk = corr_ref[0, :, pl.ds(t * T, T), :]     # (BQ, T, wl)
+        blk = corr_ref[0, :, pl.ds(t * T, T), :]
         y0 = (t * T).astype(jnp.float32)
         for yi in range(T):
             row = blk[:, yi, :]
@@ -339,7 +340,7 @@ def _pyr_fwd_kernel(corr_ref, c_ref, out_ref, *, hl, wl, k, lvl_div):
     accs = jax.lax.fori_loop(
         0, nt, tile_body,
         [jnp.zeros((bq, wl), jnp.float32) for _ in range(k)])
-    if hl % T:  # static remainder rows
+    if hl % T:
         rem = nt * T
         blk = corr_ref[0, :, rem:, :]
         for yi in range(hl - rem):
@@ -350,22 +351,23 @@ def _pyr_fwd_kernel(corr_ref, c_ref, out_ref, *, hl, wl, k, lvl_div):
 
     for i in range(k):
         for j in range(k):
-            out_ref[0, :, i * k + j] = jnp.sum(wx[i] * accs[j], axis=1)
+            out_ref[0, :, out_off + i * k + j] = \
+                jnp.sum(wx[i] * accs[j], axis=1)
 
 
-def _pyr_bwd_kernel(c_ref, g_ref, dcorr_ref, *, hl, wl, k, lvl_div):
-    """Transpose of :func:`_pyr_fwd_kernel`:
-    ``dcorr(q, y, x) = sum_ij wy_j(q, y) g(q, i, j) wx_i(q, x)``."""
+def _pyr_bwd_level_body(c_ref, g_ref, dcorr_ref, lvl, g_off, hl, wl, k):
+    """One level's transpose inside the fused kernel: scatter the taps at
+    lane offset ``g_off`` of ``g_ref`` into this level's ``dcorr``."""
     bq = c_ref.shape[1]
     r = (k - 1) // 2
+    lvl_div = 1.0 / (2.0 ** lvl)
     cx = c_ref[0, :, 0:1] * lvl_div
     cy = c_ref[0, :, 1:2] * lvl_div
     posx = jax.lax.broadcasted_iota(jnp.int32, (bq, wl), 1) \
         .astype(jnp.float32)
 
-    # b_j(q, x) = sum_i wx_i(q, x) g(q, i*k+j)
     b = [sum(_tap_weight(cx, float(i - r), posx)
-             * g_ref[0, :, i * k + j:i * k + j + 1]
+             * g_ref[0, :, g_off + i * k + j:g_off + i * k + j + 1]
              for i in range(k)) for j in range(k)]
 
     T = min(_ROW_TILE, hl)
@@ -375,7 +377,7 @@ def _pyr_bwd_kernel(c_ref, g_ref, dcorr_ref, *, hl, wl, k, lvl_div):
         return jnp.stack([
             sum(_tap_weight(cy, float(j - r - yi), y0f) * b[j]
                 for j in range(k)) for yi in yis
-        ], axis=1)                                       # (BQ, T, wl)
+        ], axis=1)
 
     def tile_body(t, _):
         dcorr_ref[0, :, pl.ds(t * T, T), :] = _rows(
@@ -388,52 +390,98 @@ def _pyr_bwd_kernel(c_ref, g_ref, dcorr_ref, *, hl, wl, k, lvl_div):
         dcorr_ref[0, :, rem:, :] = _rows(float(rem), range(hl - rem))
 
 
-def _pyr_level_fwd(corr, coords_p, level, radius, block_q, interpret):
-    B, Npad, hl, wl = corr.shape
+def _pyr_multi_fwd_kernel(*refs, levels, k, kk_total):
+    """Fused forward over every non-empty level: round-2 profiling showed
+    the per-call overhead of one pallas_call per level per direction
+    (~200 calls/step at unroll 6) costing as much as the level-0 math —
+    the small levels were pure overhead.  ``levels``: static list of
+    ``(lvl, out_off, hl, wl)``; refs = [corr_0..corr_{n-1}, c, out]."""
+    c_ref, out_ref = refs[-2], refs[-1]
+    bq = c_ref.shape[1]
+    covered = 0
+    for (lvl, off, hl, wl), corr_ref in zip(levels, refs[:-2]):
+        _pyr_fwd_level_body(corr_ref, c_ref, out_ref, lvl, off, hl, wl, k)
+        covered += k * k
+    if covered < kk_total:  # empty (over-pooled) trailing levels -> zeros
+        out_ref[0, :, covered:] = jnp.zeros((bq, kk_total - covered),
+                                            jnp.float32)
+
+
+def _pyr_multi_bwd_kernel(*refs, levels, k):
+    """Fused transpose over every non-empty level; refs =
+    [c, g, dcorr_0..dcorr_{n-1}]."""
+    c_ref, g_ref = refs[0], refs[1]
+    for (lvl, off, hl, wl), dcorr_ref in zip(levels, refs[2:]):
+        _pyr_bwd_level_body(c_ref, g_ref, dcorr_ref, lvl, off, hl, wl, k)
+
+
+def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret):
+    """All levels in ONE pallas_call -> (B, Npad, L*k*k) taps."""
+    B, Npad = pyramid[0].shape[:2]
     k = 2 * radius + 1
-    kern = functools.partial(_pyr_fwd_kernel, hl=hl, wl=wl, k=k,
-                             lvl_div=1.0 / (2.0 ** level))
+    L = len(pyramid)
+    nonempty = [(lvl, c) for lvl, c in enumerate(pyramid)
+                if c.shape[2] > 0 and c.shape[3] > 0]
+    levels = [(lvl, lvl * k * k, c.shape[2], c.shape[3])
+              for lvl, c in nonempty]
+    kern = functools.partial(_pyr_multi_fwd_kernel, levels=levels, k=k,
+                             kk_total=L * k * k)
+    in_specs = [
+        pl.BlockSpec((1, block_q) + c.shape[2:], lambda b, i: (b, i, 0, 0),
+                     memory_space=pltpu.VMEM)
+        for _, c in nonempty
+    ] + [pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+                      memory_space=pltpu.VMEM)]
     return pl.pallas_call(
         kern,
         grid=(B, Npad // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, hl, wl), lambda b, i: (b, i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, k * k), lambda b, i: (b, i, 0),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, L * k * k),
+                               lambda b, i: (b, i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, Npad, k * k), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((B, Npad, L * k * k), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(corr, coords_p)
+    )(*[c for _, c in nonempty], coords_p)
 
 
-def _pyr_level_bwd(coords_p, g_l, level, radius, block_q, hl, wl,
-                   interpret):
+def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
+    """Per-level transpose calls; ``g``: (B, Npad, L*k*k).  Unlike the
+    forward, the backwards stay SEPARATE pallas_calls: one fused call
+    producing all four dcorr outputs (537+134+33+8 MB at chairs batch 16)
+    pins the whole 712 MB group live per unrolled iteration and OOMs —
+    per-level calls let XLA's scheduler interleave each level's
+    accumulation and retire the temps early."""
     B, Npad, _ = coords_p.shape
     k = 2 * radius + 1
-    kern = functools.partial(_pyr_bwd_kernel, hl=hl, wl=wl, k=k,
-                             lvl_div=1.0 / (2.0 ** level))
-    return pl.pallas_call(
-        kern,
-        grid=(B, Npad // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, k * k), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, hl, wl),
-                               lambda b, i: (b, i, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, Npad, hl, wl), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
-        interpret=interpret,
-    )(coords_p, g_l)
+    dpyr = []
+    for lvl, s in enumerate(shapes):
+        hl, wl = s[2], s[3]
+        if hl == 0 or wl == 0:
+            dpyr.append(jnp.zeros(s, jnp.float32))
+            continue
+        kern = functools.partial(_pyr_multi_bwd_kernel,
+                                 levels=[(lvl, lvl * k * k, hl, wl)], k=k)
+        dpyr.append(pl.pallas_call(
+            kern,
+            grid=(B, Npad // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_q, k * k * len(shapes)),
+                             lambda b, i: (b, i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, hl, wl),
+                                   lambda b, i: (b, i, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((B, Npad, hl, wl), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=interpret,
+        )(coords_p, g))
+    return dpyr
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
@@ -473,16 +521,8 @@ def _pyr_fwd(pyramid, coords, radius, block_q, interpret):
             "would silently skip trailing query rows in the Pallas grid")
     k = 2 * radius + 1
     c = _pad_coords_oor(coords.reshape(B, N, 2).astype(jnp.float32), Npad)
-    outs = []
-    for lvl, lvl_corr in enumerate(pyramid):
-        if lvl_corr.shape[2] == 0 or lvl_corr.shape[3] == 0:
-            # Over-pooled tiny input: empty level samples as all zeros.
-            outs.append(jnp.zeros((B, Npad, k * k), jnp.float32))
-            continue
-        outs.append(_pyr_level_fwd(lvl_corr, c, lvl, radius, block_q,
-                                   interpret))
-    out = jnp.concatenate([o[:, :N] for o in outs], axis=-1)
-    return (out.reshape(B, H1, W1, len(pyramid) * k * k),
+    out = _pyr_levels_fwd(list(pyramid), c, radius, block_q, interpret)
+    return (out[:, :N].reshape(B, H1, W1, len(pyramid) * k * k),
             (tuple(x.shape for x in pyramid), coords))
 
 
@@ -498,22 +538,13 @@ def _pyr_bwd(radius, block_q, interpret, residuals, g):
             f"pyramid query dim {Npad} is not a multiple of block_q "
             f"{block_q}; build the pyramid with "
             f"build_corr_pyramid_flat(..., pad_q={block_q})")
-    k = 2 * radius + 1
     c = _pad_coords_oor(coords.reshape(B, N, 2).astype(jnp.float32), Npad)
     g = g.reshape(B, N, -1).astype(jnp.float32)
     if Npad != N:
         g = jnp.pad(g, ((0, 0), (0, Npad - N), (0, 0)))
-    dpyr = []
-    for lvl, shape in enumerate(shapes):
-        _, _, hl, wl = shape
-        if hl == 0 or wl == 0:
-            dpyr.append(jnp.zeros(shape, jnp.float32))
-            continue
-        g_l = g[:, :, lvl * k * k:(lvl + 1) * k * k]
-        dpyr.append(_pyr_level_bwd(c, g_l, lvl, radius, block_q, hl, wl,
-                                   interpret))
     # container must match the primal's (build_corr_pyramid_flat returns a
     # list)
+    dpyr = _pyr_levels_bwd(c, g, list(shapes), radius, block_q, interpret)
     return dpyr, jnp.zeros_like(coords)
 
 
