@@ -314,60 +314,65 @@ _ROW_TILE = 8
 
 
 def _pyr_fwd_level_body(corr_ref, c_ref, out_ref, lvl, out_off, hl, wl, k):
-    """One level's forward sampling inside the fused kernel: write
-    ``(BQ, k*k)`` taps at lane offset ``out_off`` of ``out_ref``."""
-    bq = corr_ref.shape[1]
+    """One level's forward sampling inside the fused kernel (QUERY-MINOR:
+    queries live in lanes, x in sublanes): write ``(k*k, BQ)`` taps at
+    sublane offset ``out_off`` of ``out_ref``.
+
+    corr_ref: (1, hl, wl, BQ); c_ref: (1, 2, BQ); out: (1, L*k*k, BQ)."""
+    bq = c_ref.shape[2]
     r = (k - 1) // 2
     lvl_div = 1.0 / (2.0 ** lvl)
-    cx = c_ref[0, :, 0:1] * lvl_div
-    cy = c_ref[0, :, 1:2] * lvl_div
-    posx = jax.lax.broadcasted_iota(jnp.int32, (bq, wl), 1) \
+    cx = c_ref[0, 0:1, :] * lvl_div      # (1, BQ)
+    cy = c_ref[0, 1:2, :] * lvl_div
+    posx = jax.lax.broadcasted_iota(jnp.int32, (wl, bq), 0) \
         .astype(jnp.float32)
-    wx = [_tap_weight(cx, float(i - r), posx) for i in range(k)]
+    wx = [_tap_weight(cx, float(i - r), posx) for i in range(k)]  # (wl,BQ)
 
     T = min(_ROW_TILE, hl)
     nt = hl // T
 
     def tile_body(t, accs):
-        blk = corr_ref[0, :, pl.ds(t * T, T), :]
+        blk = corr_ref[0, pl.ds(t * T, T), :, :]     # (T, wl, BQ)
         y0 = (t * T).astype(jnp.float32)
         for yi in range(T):
-            row = blk[:, yi, :]
+            row = blk[yi, :, :]
             for j in range(k):
                 accs[j] += _tap_weight(cy, float(j - r - yi), y0) * row
         return accs
 
     accs = jax.lax.fori_loop(
         0, nt, tile_body,
-        [jnp.zeros((bq, wl), jnp.float32) for _ in range(k)])
+        [jnp.zeros((wl, bq), jnp.float32) for _ in range(k)])
     if hl % T:
         rem = nt * T
-        blk = corr_ref[0, :, rem:, :]
+        blk = corr_ref[0, rem:, :, :]
         for yi in range(hl - rem):
-            row = blk[:, yi, :]
+            row = blk[yi, :, :]
             for j in range(k):
                 accs[j] += _tap_weight(cy, float(j - r - yi),
                                        float(rem)) * row
 
     for i in range(k):
         for j in range(k):
-            out_ref[0, :, out_off + i * k + j] = \
-                jnp.sum(wx[i] * accs[j], axis=1)
+            out_ref[0, out_off + i * k + j:out_off + i * k + j + 1, :] = \
+                jnp.sum(wx[i] * accs[j], axis=0, keepdims=True)
 
 
 def _pyr_bwd_level_body(c_ref, g_ref, dcorr_ref, lvl, g_off, hl, wl, k):
-    """One level's transpose inside the fused kernel: scatter the taps at
-    lane offset ``g_off`` of ``g_ref`` into this level's ``dcorr``."""
-    bq = c_ref.shape[1]
+    """One level's transpose inside the fused kernel (QUERY-MINOR):
+    scatter the taps at sublane offset ``g_off`` of ``g_ref`` into this
+    level's ``dcorr`` (1, hl, wl, BQ)."""
+    bq = c_ref.shape[2]
     r = (k - 1) // 2
     lvl_div = 1.0 / (2.0 ** lvl)
-    cx = c_ref[0, :, 0:1] * lvl_div
-    cy = c_ref[0, :, 1:2] * lvl_div
-    posx = jax.lax.broadcasted_iota(jnp.int32, (bq, wl), 1) \
+    cx = c_ref[0, 0:1, :] * lvl_div
+    cy = c_ref[0, 1:2, :] * lvl_div
+    posx = jax.lax.broadcasted_iota(jnp.int32, (wl, bq), 0) \
         .astype(jnp.float32)
 
+    # b_j(x, q) = sum_i wx_i(x, q) g(i*k+j, q)
     b = [sum(_tap_weight(cx, float(i - r), posx)
-             * g_ref[0, :, g_off + i * k + j:g_off + i * k + j + 1]
+             * g_ref[0, g_off + i * k + j:g_off + i * k + j + 1, :]
              for i in range(k)) for j in range(k)]
 
     T = min(_ROW_TILE, hl)
@@ -377,17 +382,17 @@ def _pyr_bwd_level_body(c_ref, g_ref, dcorr_ref, lvl, g_off, hl, wl, k):
         return jnp.stack([
             sum(_tap_weight(cy, float(j - r - yi), y0f) * b[j]
                 for j in range(k)) for yi in yis
-        ], axis=1)
+        ], axis=0)                                   # (T, wl, BQ)
 
     def tile_body(t, _):
-        dcorr_ref[0, :, pl.ds(t * T, T), :] = _rows(
+        dcorr_ref[0, pl.ds(t * T, T), :, :] = _rows(
             (t * T).astype(jnp.float32), range(T))
         return 0
 
     jax.lax.fori_loop(0, nt, tile_body, 0)
     if hl % T:
         rem = nt * T
-        dcorr_ref[0, :, rem:, :] = _rows(float(rem), range(hl - rem))
+        dcorr_ref[0, rem:, :, :] = _rows(float(rem), range(hl - rem))
 
 
 def _pyr_multi_fwd_kernel(*refs, levels, k, kk_total):
@@ -397,13 +402,13 @@ def _pyr_multi_fwd_kernel(*refs, levels, k, kk_total):
     the small levels were pure overhead.  ``levels``: static list of
     ``(lvl, out_off, hl, wl)``; refs = [corr_0..corr_{n-1}, c, out]."""
     c_ref, out_ref = refs[-2], refs[-1]
-    bq = c_ref.shape[1]
+    bq = c_ref.shape[2]
     covered = 0
     for (lvl, off, hl, wl), corr_ref in zip(levels, refs[:-2]):
         _pyr_fwd_level_body(corr_ref, c_ref, out_ref, lvl, off, hl, wl, k)
         covered += k * k
     if covered < kk_total:  # empty (over-pooled) trailing levels -> zeros
-        out_ref[0, :, covered:] = jnp.zeros((bq, kk_total - covered),
+        out_ref[0, covered:, :] = jnp.zeros((kk_total - covered, bq),
                                             jnp.float32)
 
 
@@ -416,30 +421,37 @@ def _pyr_multi_bwd_kernel(*refs, levels, k):
 
 
 def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret):
-    """All levels in ONE pallas_call -> (B, Npad, L*k*k) taps."""
-    B, Npad = pyramid[0].shape[:2]
+    """All levels in ONE pallas_call -> (B, L*k*k, Npad) taps.
+
+    Query-minor layout throughout: ``pyramid`` levels are
+    ``(B, hl, wl, Npad)`` and ``coords_p`` is ``(B, 2, Npad)`` — queries
+    in lanes, so every VMEM/HBM tile is dense (Npad is a multiple of
+    128) and the per-tap contraction is a sublane reduction."""
+    B = pyramid[0].shape[0]
+    Npad = pyramid[0].shape[3]
     k = 2 * radius + 1
     L = len(pyramid)
     nonempty = [(lvl, c) for lvl, c in enumerate(pyramid)
-                if c.shape[2] > 0 and c.shape[3] > 0]
-    levels = [(lvl, lvl * k * k, c.shape[2], c.shape[3])
+                if c.shape[1] > 0 and c.shape[2] > 0]
+    levels = [(lvl, lvl * k * k, c.shape[1], c.shape[2])
               for lvl, c in nonempty]
     kern = functools.partial(_pyr_multi_fwd_kernel, levels=levels, k=k,
                              kk_total=L * k * k)
     in_specs = [
-        pl.BlockSpec((1, block_q) + c.shape[2:], lambda b, i: (b, i, 0, 0),
+        pl.BlockSpec((1, c.shape[1], c.shape[2], block_q),
+                     lambda b, i: (b, 0, 0, i),
                      memory_space=pltpu.VMEM)
         for _, c in nonempty
-    ] + [pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+    ] + [pl.BlockSpec((1, 2, block_q), lambda b, i: (b, 0, i),
                       memory_space=pltpu.VMEM)]
     return pl.pallas_call(
         kern,
         grid=(B, Npad // block_q),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, L * k * k),
-                               lambda b, i: (b, i, 0),
+        out_specs=pl.BlockSpec((1, L * k * k, block_q),
+                               lambda b, i: (b, 0, i),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, Npad, L * k * k), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((B, L * k * k, Npad), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
@@ -447,17 +459,18 @@ def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret):
 
 
 def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
-    """Per-level transpose calls; ``g``: (B, Npad, L*k*k).  Unlike the
-    forward, the backwards stay SEPARATE pallas_calls: one fused call
-    producing all four dcorr outputs (537+134+33+8 MB at chairs batch 16)
-    pins the whole 712 MB group live per unrolled iteration and OOMs —
-    per-level calls let XLA's scheduler interleave each level's
-    accumulation and retire the temps early."""
-    B, Npad, _ = coords_p.shape
+    """Per-level transpose calls; ``g``: (B, L*k*k, Npad), levels
+    query-minor (B, hl, wl, Npad).  Unlike the forward, the backwards
+    stay SEPARATE pallas_calls: one fused call producing all four dcorr
+    outputs (537+134+33+8 MB at chairs batch 16) pins the whole 712 MB
+    group live per unrolled iteration and OOMs — per-level calls let
+    XLA's scheduler interleave each level's accumulation and retire the
+    temps early."""
+    B, _, Npad = coords_p.shape
     k = 2 * radius + 1
     dpyr = []
     for lvl, s in enumerate(shapes):
-        hl, wl = s[2], s[3]
+        hl, wl = s[1], s[2]
         if hl == 0 or wl == 0:
             dpyr.append(jnp.zeros(s, jnp.float32))
             continue
@@ -467,16 +480,16 @@ def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
             kern,
             grid=(B, Npad // block_q),
             in_specs=[
-                pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+                pl.BlockSpec((1, 2, block_q), lambda b, i: (b, 0, i),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_q, k * k * len(shapes)),
-                             lambda b, i: (b, i, 0),
+                pl.BlockSpec((1, k * k * len(shapes), block_q),
+                             lambda b, i: (b, 0, i),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec((1, block_q, hl, wl),
-                                   lambda b, i: (b, i, 0, 0),
+            out_specs=pl.BlockSpec((1, hl, wl, block_q),
+                                   lambda b, i: (b, 0, 0, i),
                                    memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((B, Npad, hl, wl), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((B, hl, wl, Npad), jnp.float32),
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=interpret,
@@ -494,9 +507,10 @@ def pallas_pyramid_lookup(pyramid, coords, radius: int = 4,
     contract, same zeros-padding bilinear semantics.
 
     Args:
-      pyramid: list of ``(B, Npad, Hl, Wl)`` fp32 levels whose query dim is
-        already padded to a multiple of ``block_q`` (pad ``fmap1`` before
-        ``build_corr_pyramid`` — zero rows correlate to zero).
+      pyramid: list of ``(B, Hl, Wl, Npad)`` fp32 QUERY-MINOR levels
+        (from :func:`raft_tpu.ops.corr.build_corr_pyramid_flat`) whose
+        query dim is already padded to a multiple of ``block_q`` (zero
+        fmap1 rows correlate to zero).
       coords: ``(B, H1, W1, 2)`` level-0 centroids (N = H1*W1 real
         queries), last axis ``(x, y)``.
 
@@ -512,17 +526,19 @@ def _pyr_fwd(pyramid, coords, radius, block_q, interpret):
         interpret = _auto_interpret()
     B, H1, W1, _ = coords.shape
     N = H1 * W1
-    Npad = pyramid[0].shape[1]
+    Npad = pyramid[0].shape[3]
     if Npad % block_q:
         raise ValueError(
             f"pyramid query dim {Npad} is not a multiple of block_q "
             f"{block_q}; build the pyramid with "
             f"build_corr_pyramid_flat(..., pad_q={block_q}) — a mismatch "
-            "would silently skip trailing query rows in the Pallas grid")
+            "would silently skip trailing query lanes in the Pallas grid")
     k = 2 * radius + 1
-    c = _pad_coords_oor(coords.reshape(B, N, 2).astype(jnp.float32), Npad)
+    c = _pad_coords_oor(coords.reshape(B, N, 2).astype(jnp.float32),
+                        Npad).transpose(0, 2, 1)
     out = _pyr_levels_fwd(list(pyramid), c, radius, block_q, interpret)
-    return (out[:, :N].reshape(B, H1, W1, len(pyramid) * k * k),
+    out = out[:, :, :N].reshape(B, len(pyramid) * k * k, H1, W1)
+    return (out.transpose(0, 2, 3, 1),
             (tuple(x.shape for x in pyramid), coords))
 
 
@@ -532,16 +548,17 @@ def _pyr_bwd(radius, block_q, interpret, residuals, g):
         interpret = _auto_interpret()
     B, H1, W1, _ = coords.shape
     N = H1 * W1
-    Npad = shapes[0][1]
+    Npad = shapes[0][3]
     if Npad % block_q:
         raise ValueError(
             f"pyramid query dim {Npad} is not a multiple of block_q "
             f"{block_q}; build the pyramid with "
             f"build_corr_pyramid_flat(..., pad_q={block_q})")
-    c = _pad_coords_oor(coords.reshape(B, N, 2).astype(jnp.float32), Npad)
-    g = g.reshape(B, N, -1).astype(jnp.float32)
+    c = _pad_coords_oor(coords.reshape(B, N, 2).astype(jnp.float32),
+                        Npad).transpose(0, 2, 1)
+    g = g.reshape(B, N, -1).transpose(0, 2, 1).astype(jnp.float32)
     if Npad != N:
-        g = jnp.pad(g, ((0, 0), (0, Npad - N), (0, 0)))
+        g = jnp.pad(g, ((0, 0), (0, 0), (0, Npad - N)))
     # container must match the primal's (build_corr_pyramid_flat returns a
     # list)
     dpyr = _pyr_levels_bwd(c, g, list(shapes), radius, block_q, interpret)
