@@ -43,8 +43,12 @@ def bench_eval():
     model = RAFT(cfg)
     rng = jax.random.PRNGKey(0)
     img = jax.random.uniform(rng, (1, H, W, 3), np.float32) * 255.0
-    variables = model.init({"params": rng, "dropout": rng}, img, img,
-                           iters=2, train=False)
+    # Jitted tiny-shape init (conv params are size-independent; unjitted
+    # full-shape init dispatches op-by-op through the axon tunnel).
+    small = jax.random.uniform(rng, (1, 64, 96, 3), np.float32)
+    variables = jax.jit(
+        lambda k: model.init({"params": k, "dropout": k}, small, small,
+                             iters=2, train=False))(rng)
 
     # The real inference entry point (it pins scan_unroll=1 — the config
     # default tunes the training backward pass).
@@ -112,7 +116,10 @@ def main():
     model = RAFT(model_cfg)
     tx = make_optimizer(cfg.lr, cfg.num_steps, cfg.wdecay, cfg.epsilon,
                         cfg.clip)
-    state = init_state(model, tx, jax.random.PRNGKey(0), (H, W))
+    # Tiny-shape init: conv/GRU param shapes don't depend on image size,
+    # and unjitted full-shape init dispatches op-by-op through the axon
+    # remote-compile tunnel (minutes of the old bench wall clock).
+    state = init_state(model, tx, jax.random.PRNGKey(0), (48, 64))
     step_fn = make_train_step(model, tx, cfg, mesh)
 
     rng = np.random.default_rng(0)

@@ -63,12 +63,13 @@ class RAFTConfig:
     # einsum outputs (measured slower: HBM pressure).
     remat_policy: str = "save_corr"
     # Refinement-scan unroll factor (lax.scan unroll): trades compile
-    # time/code size for less per-iteration loop overhead.  With the
-    # lighter scan body (upsample hoisted out) + save_corr, unroll pays:
-    # measured 1/2/3/4/6/12 -> 15.8/16.2/16.2/16.1/18.7(batch 16)/OOM
-    # pairs/s/chip on v5e (it lost with the old heavy body; re-measure
-    # if the body changes).
-    scan_unroll: int = 6
+    # time/code size for less per-iteration loop overhead.  Round-1
+    # sweep (heavier body): 1/2/3/4/6 -> 15.8/16.2/16.2/16.1/18.7,
+    # 12 OOM.  Round 2 (flat fused loss + query-minor pyramid freed the
+    # HBM the unrolled backward needs): batch 16 unroll 6 -> 54.3,
+    # unroll 12 -> 56.0 pairs/s/chip — full unroll now fits and wins;
+    # re-measure if the body changes.
+    scan_unroll: int = 12
     # Rematerialize the upsample stage (mask head + convex upsample, which
     # runs in its own scan *after* the GRU refinement scan) in backward.
     # Its residuals are ~1-2 GB at training shapes; recompute is two convs

@@ -35,8 +35,15 @@ level-major — identical to ``raft_tpu.ops.corr`` and the reference
 (corr.py:36-41).
 
 Blocking: queries are processed in ``block_q`` chunks (grid = (B, N/BQ));
-each kernel instance holds one level's ``f2`` and one query block's rows in
-VMEM.  The correlation volume never exists in HBM.
+one fused kernel instance holds EVERY level's ``f2`` and one query block's
+rows in VMEM.  The correlation volume never exists in HBM.
+
+Toolchain caveat (round 2): the fused on-demand bodies (MXU mat-muls
+inside y-tile fori loops x 4 levels) compile correctly in interpret mode
+and pass parity/gradient tests, but Mosaic+remote compile on the current
+axon toolchain exceeded 20-40 minute budgets at both eval-720p and
+training-crop shapes, so ``corr_impl='pallas'`` is opt-in and
+``--alternate_corr`` maps to the XLA ``chunked`` path (see ROADMAP.md).
 """
 
 from __future__ import annotations
@@ -62,33 +69,25 @@ def _tap_weight(c: jax.Array, offset, pos) -> jax.Array:
     return jnp.maximum(0.0, 1.0 - jnp.abs(c + offset - pos))
 
 
-def _fwd_kernel(f1_ref, c_ref, f2_ref, out_ref, *, hl, wl, k, inv_scale,
-                lvl_div):
-    """Mosaic-friendly layout: queries live in the LANE dim everywhere
-    (lane-dim reshapes and mismatched-batch dots are unsupported).  The
-    kernel streams f2 row-by-row: one (Wl, C) x (C, BQ) mat-mul per image
-    row, accumulated into the window taps with per-row bilinear weights —
-    the correlation rows never exist at once, not even in VMEM."""
-    f1 = f1_ref[0]                      # (BQ, C)
+def _odm_fwd_level_body(f2_ref, f1, c_ref, out_ref, lvl, off, hl, wl, k,
+                        inv_scale):
+    """One level of the fused on-demand forward: stream ``f2`` in
+    y-tiles, one (T*Wl, C) x (C, BQ) mat-mul per tile (the correlation
+    rows never exist at once, not even in VMEM), accumulate the K
+    vertical taps, contract x by a sublane reduction, and write this
+    level's ``(k*k, BQ)`` tap slice at sublane offset ``off``."""
     bq = f1.shape[0]
     r = (k - 1) // 2
+    lvl_div = 1.0 / (2.0 ** lvl)
     cx = c_ref[0, :, 0] * lvl_div       # (BQ,)
     cy = c_ref[0, :, 1] * lvl_div
     posx = jax.lax.broadcasted_iota(jnp.int32, (wl, bq), 0) \
         .astype(jnp.float32)            # (Wl, BQ)
-
-    # y-tiled row computation: one (T*Wl, C) x (C, BQ) mat-mul per tile of
-    # T image rows (big MXU work), with the K vertical-tap accumulations
-    # statically unrolled inside the tile.  A tile size of 8 keeps the
-    # Mosaic unroll small (full static unroll over hl explodes compile
-    # time; per-row matmuls are latency-bound).
     t_y = min(_Y_TILE, hl)
     n_tiles = hl // t_y
     C = f1.shape[-1]
 
     def _tile_taps(y0f, yis, f2_t, acc):
-        """Accumulate the vertical taps for ``len(yis)`` image rows whose
-        flat features are ``f2_t`` (rows start at traced/static ``y0f``)."""
         rows3 = (jax.lax.dot_general(
             f2_t, f1, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -112,49 +111,64 @@ def _fwd_kernel(f1_ref, c_ref, f2_ref, out_ref, *, hl, wl, k, inv_scale,
         f2_t = f2_ref[0, rem:].reshape((hl - rem) * wl, C)
         acc = _tile_taps(jnp.float32(rem), range(hl - rem), f2_t, acc)
 
-    # Contract x with a ones-row mat-mul: Mosaic can't emit sublane
-    # reductions with 1-D outputs, but (1, Wl) @ (Wl, BQ) is plain MXU.
+    # Contract x with a ones-row mat-mul: Mosaic rejects this particular
+    # sublane multi_reduction ("unsupported output implicit dimension")
+    # at on-demand shapes, but (1, Wl) @ (Wl, BQ) is plain MXU.
     ones_row = jnp.ones((1, wl), jnp.float32)
     for i in range(k):
         wx_i = _tap_weight(cx[None, :], float(i - r), posx)  # (Wl, BQ)
         for j in range(k):
-            out_ref[0, 0, i, j:j + 1, :] = jax.lax.dot_general(
-                ones_row, wx_i * acc[j], (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)          # (1, BQ)
+            out_ref[0, off + i * k + j:off + i * k + j + 1, :] = \
+                jax.lax.dot_general(
+                    ones_row, wx_i * acc[j], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
 
 
-def _bwd_kernel(f1_ref, c_ref, f2_ref, g_ref, df1_ref, df2_ref, *,
-                hl, wl, k, inv_scale, lvl_div):
-    """Transpose of the forward row-streaming: per image row y,
-    ``drows_y(x, q) = sum_ij g(i,j,q) wx_i(x,q) wy_j(y,q)`` feeds two 2-D
-    mat-muls — ``df1 += drows_y @ f2_y`` and ``df2[y] = drows_y^T-style
+def _odm_fwd_kernel(*refs, levels, k, kk_total, inv_scale):
+    """Fused on-demand forward over every non-empty level (ONE
+    pallas_call per lookup instead of one per level — the per-call
+    overhead dominated the small levels).  refs =
+    [f2_0..f2_{n-1}, f1, c, out]; out: (1, L*k*k, BQ) query-minor."""
+    f1_ref, c_ref, out_ref = refs[-3], refs[-2], refs[-1]
+    f1 = f1_ref[0]                      # (BQ, C)
+    covered = 0
+    for (lvl, off, hl, wl), f2_ref in zip(levels, refs[:-3]):
+        _odm_fwd_level_body(f2_ref, f1, c_ref, out_ref, lvl, off, hl, wl,
+                            k, inv_scale)
+        covered += k * k
+    if covered < kk_total:  # empty (over-pooled) trailing levels
+        out_ref[0, covered:, :] = jnp.zeros(
+            (kk_total - covered, f1.shape[0]), jnp.float32)
+
+
+def _odm_bwd_level_body(f2_ref, df2_ref, f1, c_ref, g_ref, lvl, off, hl,
+                        wl, k, inv_scale, is_first_block, df1):
+    """One level of the fused on-demand backward: per image row y,
+    ``drows_y(x, q) = sum_ij g(i,j,q) wx_i(x,q) wy_j(y,q)`` feeds two
+    mat-muls — ``df1 += drows @ f2`` and ``df2[y-tile] += drows^T-style
     contraction over queries`` (accumulated across query blocks; the TPU
     grid runs sequentially, so no atomics are needed — unlike the
     reference's atomicAdd scatter, correlation_kernel.cu:237)."""
-    i = pl.program_id(1)
-    f1 = f1_ref[0]                      # (BQ, C)
     bq = f1.shape[0]
     r = (k - 1) // 2
-    g = g_ref[0, 0]                     # (K_i, K_j, BQ)
+    lvl_div = 1.0 / (2.0 ** lvl)
     cx = c_ref[0, :, 0] * lvl_div
     cy = c_ref[0, :, 1] * lvl_div
     posx = jax.lax.broadcasted_iota(jnp.int32, (wl, bq), 0) \
         .astype(jnp.float32)
 
-    # b_j(x, q) = sum_i wx_i(x, q) g(i, j, q)
+    # b_j(x, q) = sum_i wx_i(x, q) g(i*k+j, q)
     b = [
-        sum((_tap_weight(cx[None, :], float(ti - r), posx)
-             * g[ti, tj][None, :]) for ti in range(k))
+        sum(_tap_weight(cx[None, :], float(ti - r), posx)
+            * g_ref[0, off + ti * k + tj:off + ti * k + tj + 1, :]
+            for ti in range(k))
         for tj in range(k)
     ]                                    # K_j x (Wl, BQ)
 
-    # df2 accumulates over query blocks (TPU grid runs sequentially).
-    @pl.when(i == 0)
+    @pl.when(is_first_block)
     def _():
         df2_ref[0] = jnp.zeros_like(df2_ref[0])
 
-    # y-tiled: assemble drows for T image rows (static unroll inside the
-    # tile), then two (T*Wl)-sized mat-muls per tile.
     C = f1.shape[-1]
     t_y = min(_Y_TILE, hl)
     n_tiles = hl // t_y
@@ -165,11 +179,9 @@ def _bwd_kernel(f1_ref, c_ref, f2_ref, g_ref, df1_ref, df2_ref, *,
                 for tj in range(k))
             for yi in yis
         ], axis=0) * inv_scale                           # (T*Wl, BQ)
-        # df1(q, c) += sum_yx drows(yx, q) f2_t(yx, c)
         df1 = df1 + jax.lax.dot_general(
             drows, f2_t, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (BQ, C)
-        # df2(yx, c) += sum_q drows(yx, q) f1(q, c)
         df2_t = jax.lax.dot_general(
             drows, f1, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (T*Wl, C)
@@ -182,14 +194,33 @@ def _bwd_kernel(f1_ref, c_ref, f2_ref, g_ref, df1_ref, df2_ref, *,
         df2_ref[0, pl.ds(t * t_y, t_y)] += df2_t.reshape(t_y, wl, C)
         return df1
 
-    df1 = jax.lax.fori_loop(0, n_tiles, tile_body,
-                            jnp.zeros((bq, C), jnp.float32))
+    df1 = jax.lax.fori_loop(0, n_tiles, tile_body, df1)
     if hl % t_y:  # static remainder rows
         rem = hl - hl % t_y
         f2_t = f2_ref[0, rem:].reshape((hl - rem) * wl, C)
         df1, df2_t = _tile_grads(jnp.float32(rem), range(hl - rem), f2_t,
                                  df1)
         df2_ref[0, rem:] += df2_t.reshape(hl - rem, wl, C)
+    return df1
+
+
+def _odm_bwd_kernel(*refs, levels, k, inv_scale):
+    """Fused on-demand backward; refs = [f2_0.., f1, c, g, df1,
+    df2_0..].  ``df1`` accumulates across levels in registers and is
+    written once; each level's ``df2`` accumulates across query blocks
+    in HBM (sequential grid)."""
+    nl = len(levels)
+    f1_ref, c_ref, g_ref, df1_ref = refs[nl], refs[nl + 1], refs[nl + 2], \
+        refs[nl + 3]
+    df2_refs = refs[nl + 4:]
+    f1 = f1_ref[0]
+    is_first = pl.program_id(1) == 0
+    df1 = jnp.zeros((f1.shape[0], f1.shape[1]), jnp.float32)
+    for (lvl, off, hl, wl), f2_ref, df2_ref in zip(levels, refs[:nl],
+                                                   df2_refs):
+        df1 = _odm_bwd_level_body(f2_ref, df2_ref, f1, c_ref, g_ref, lvl,
+                                  off, hl, wl, k, inv_scale, is_first,
+                                  df1)
     df1_ref[0] = df1
 
 
@@ -212,79 +243,6 @@ def _pad_queries(f1, coords, block_q):
         f1 = jnp.pad(f1, ((0, 0), (0, pad), (0, 0)))
         coords = _pad_coords_oor(coords, nblocks * block_q)
     return f1, coords, nblocks
-
-
-def _level_fwd(f1p, coords_p, f2, level, radius, block_q, interpret):
-    B, Npad, C = f1p.shape
-    _, hl, wl, _ = f2.shape
-    k = 2 * radius + 1
-    nblocks = Npad // block_q
-    kern = functools.partial(
-        _fwd_kernel, hl=hl, wl=wl, k=k,
-        inv_scale=1.0 / float(C) ** 0.5, lvl_div=1.0 / (2.0 ** level))
-    out = pl.pallas_call(
-        kern,
-        grid=(B, nblocks),
-        in_specs=[
-            pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, hl, wl, C), lambda b, i: (b, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        # Taps are emitted query-last (K_i, K_j, BQ) so queries stay in
-        # lanes; the cheap transpose back to query-major happens in XLA.
-        out_specs=pl.BlockSpec((1, 1, k, k, block_q),
-                               lambda b, i: (b, i, 0, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, nblocks, k, k, block_q),
-                                       jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
-        interpret=interpret,
-    )(f1p, coords_p, f2.astype(jnp.float32))
-    # (B, nblocks, K, K, BQ) -> (B, Npad, K*K)
-    return out.transpose(0, 1, 4, 2, 3).reshape(B, Npad, k * k)
-
-
-def _level_bwd(f1p, coords_p, f2, g, level, radius, block_q, interpret):
-    """``g``: (B, nblocks, K, K, BQ) query-last cotangent blocks."""
-    B, Npad, C = f1p.shape
-    _, hl, wl, _ = f2.shape
-    k = 2 * radius + 1
-    nblocks = Npad // block_q
-    kern = functools.partial(
-        _bwd_kernel, hl=hl, wl=wl, k=k,
-        inv_scale=1.0 / float(C) ** 0.5, lvl_div=1.0 / (2.0 ** level))
-    return pl.pallas_call(
-        kern,
-        grid=(B, nblocks),
-        in_specs=[
-            pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, hl, wl, C), lambda b, i: (b, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, k, k, block_q),
-                         lambda b, i: (b, i, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, hl, wl, C), lambda b, i: (b, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((B, Npad, C), jnp.float32),
-            jax.ShapeDtypeStruct((B, hl, wl, C), jnp.float32),
-        ),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
-        interpret=interpret,
-    )(f1p, coords_p, f2.astype(jnp.float32), g)
 
 
 def _auto_interpret() -> bool:
@@ -431,10 +389,7 @@ def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret):
     Npad = pyramid[0].shape[3]
     k = 2 * radius + 1
     L = len(pyramid)
-    nonempty = [(lvl, c) for lvl, c in enumerate(pyramid)
-                if c.shape[1] > 0 and c.shape[2] > 0]
-    levels = [(lvl, lvl * k * k, c.shape[1], c.shape[2])
-              for lvl, c in nonempty]
+    nonempty, levels = _odm_levels(pyramid, k)
     kern = functools.partial(_pyr_multi_fwd_kernel, levels=levels, k=k,
                              kk_total=L * k * k)
     in_specs = [
@@ -591,29 +546,53 @@ def pallas_corr_lookup(fmap1, fmap2_pyramid, coords, radius: int = 4,
     return out
 
 
+def _odm_levels(fmap2_pyramid, k):
+    nonempty = [(lvl, f2) for lvl, f2 in enumerate(fmap2_pyramid)
+                if f2.shape[1] > 0 and f2.shape[2] > 0]
+    levels = [(lvl, lvl * k * k, f2.shape[1], f2.shape[2])
+              for lvl, f2 in nonempty]
+    return nonempty, levels
+
+
 def _corr_fwd(fmap1, fmap2_pyramid, coords, radius, block_q, interpret):
     if interpret is None:
         interpret = _auto_interpret()
     B, H1, W1, C = fmap1.shape
     N = H1 * W1
     k = 2 * radius + 1
+    L = len(fmap2_pyramid)
     f1 = fmap1.reshape(B, N, C).astype(jnp.float32)
     c = coords.reshape(B, N, 2).astype(jnp.float32)
     f1p, cp, _ = _pad_queries(f1, c, block_q)
-
     Npad = f1p.shape[1]
-    outs = []
-    for lvl, f2 in enumerate(fmap2_pyramid):
-        _, hl, wl, _ = f2.shape
-        if hl == 0 or wl == 0:
-            # Over-pooled tiny input: an empty level samples as all zeros
-            # (zeros-padding semantics).
-            outs.append(jnp.zeros((B, Npad, k * k), jnp.float32))
-            continue
-        outs.append(_level_fwd(f1p, cp, f2, lvl, radius, block_q,
-                               interpret))
-    out = jnp.concatenate([o[:, :N] for o in outs], axis=-1)
-    out = out.reshape(B, H1, W1, len(outs) * k * k)
+
+    nonempty, levels = _odm_levels(fmap2_pyramid, k)
+    kern = functools.partial(_odm_fwd_kernel, levels=levels, k=k,
+                             kk_total=L * k * k,
+                             inv_scale=1.0 / float(C) ** 0.5)
+    in_specs = [
+        pl.BlockSpec((1, f2.shape[1], f2.shape[2], C),
+                     lambda b, i: (b, 0, 0, 0), memory_space=pltpu.VMEM)
+        for _, f2 in nonempty
+    ] + [
+        pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    out = pl.pallas_call(
+        kern,
+        grid=(B, Npad // block_q),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, L * k * k, block_q),
+                               lambda b, i: (b, 0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, L * k * k, Npad), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*[f2.astype(jnp.float32) for _, f2 in nonempty], f1p, cp)
+    out = out[:, :, :N].reshape(B, L * k * k, H1, W1).transpose(0, 2, 3, 1)
     return out, (fmap1, tuple(fmap2_pyramid), coords)
 
 
@@ -624,32 +603,58 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
     B, H1, W1, C = fmap1.shape
     N = H1 * W1
     k = 2 * radius + 1
+    L = len(fmap2_pyramid)
     f1 = fmap1.reshape(B, N, C).astype(jnp.float32)
     c = coords.reshape(B, N, 2).astype(jnp.float32)
-    f1p, cp, nblocks = _pad_queries(f1, c, block_q)
+    f1p, cp, _ = _pad_queries(f1, c, block_q)
     Npad = f1p.shape[1]
 
-    g = g.reshape(B, N, -1).astype(jnp.float32)
+    g = g.reshape(B, N, -1).transpose(0, 2, 1).astype(jnp.float32)
     if Npad != N:
-        g = jnp.pad(g, ((0, 0), (0, Npad - N), (0, 0)))
+        g = jnp.pad(g, ((0, 0), (0, 0), (0, Npad - N)))
 
-    nblocks = Npad // block_q
-    df1 = jnp.zeros((B, Npad, C), jnp.float32)
+    nonempty, levels = _odm_levels(fmap2_pyramid, k)
+    kern = functools.partial(_odm_bwd_kernel, levels=levels, k=k,
+                             inv_scale=1.0 / float(C) ** 0.5)
+    in_specs = [
+        pl.BlockSpec((1, f2.shape[1], f2.shape[2], C),
+                     lambda b, i: (b, 0, 0, 0), memory_space=pltpu.VMEM)
+        for _, f2 in nonempty
+    ] + [
+        pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, L * k * k, block_q), lambda b, i: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_specs = (pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
+                              memory_space=pltpu.VMEM),) + tuple(
+        pl.BlockSpec((1, f2.shape[1], f2.shape[2], C),
+                     lambda b, i: (b, 0, 0, 0), memory_space=pltpu.VMEM)
+        for _, f2 in nonempty)
+    out_shape = (jax.ShapeDtypeStruct((B, Npad, C), jnp.float32),) + tuple(
+        jax.ShapeDtypeStruct((B, f2.shape[1], f2.shape[2], C), jnp.float32)
+        for _, f2 in nonempty)
+    outs = pl.pallas_call(
+        kern,
+        grid=(B, Npad // block_q),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*[f2.astype(jnp.float32) for _, f2 in nonempty], f1p, cp, g)
+
+    df1 = outs[0][:, :N].reshape(fmap1.shape).astype(fmap1.dtype)
     df2s = []
-    for lvl, f2 in enumerate(fmap2_pyramid):
-        _, hl, wl, _ = f2.shape
-        if hl == 0 or wl == 0:
+    it = iter(outs[1:])
+    for f2 in fmap2_pyramid:
+        if f2.shape[1] > 0 and f2.shape[2] > 0:
+            df2s.append(next(it).astype(f2.dtype))
+        else:
             df2s.append(jnp.zeros_like(f2))
-            continue
-        # (B, Npad, K*K) -> query-last blocks (B, nblocks, K, K, BQ)
-        g_l = g[:, :, lvl * k * k:(lvl + 1) * k * k] \
-            .reshape(B, nblocks, block_q, k, k).transpose(0, 1, 3, 4, 2)
-        df1_l, df2_l = _level_bwd(f1p, cp, f2, g_l, lvl, radius,
-                                  block_q, interpret)
-        df1 = df1 + df1_l
-        df2s.append(df2_l.astype(f2.dtype))
-
-    df1 = df1[:, :N].reshape(fmap1.shape).astype(fmap1.dtype)
     # coords gradient is structurally zero (reference detaches coords each
     # iteration, raft.py:123; CUDA kernel never fills coords_grad).
     return df1, tuple(df2s), jnp.zeros_like(coords)

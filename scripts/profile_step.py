@@ -58,7 +58,7 @@ def capture(outdir: str) -> str:
     model = RAFT(model_cfg)
     tx = make_optimizer(cfg.lr, cfg.num_steps, cfg.wdecay, cfg.epsilon,
                         cfg.clip)
-    state = init_state(model, tx, jax.random.PRNGKey(0), (H, W))
+    state = init_state(model, tx, jax.random.PRNGKey(0), (48, 64))
     step_fn = make_train_step(model, tx, cfg, mesh)
 
     rng = np.random.default_rng(0)
