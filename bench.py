@@ -98,7 +98,11 @@ def main():
     # (README.md:75-80).
     corr_impl = os.environ.get("BENCH_CORR_IMPL", "allpairs_pallas")
     corr_precision = os.environ.get("BENCH_CORR_PRECISION", "highest")
-    remat = os.environ.get("BENCH_REMAT", "1") == "1"
+    # remat off is fastest at the chairs bench shape now that the flat
+    # fused loss + query-minor pyramid freed the activation memory
+    # (59.5 vs 55.8 pairs/s/chip with save_corr, round 2); larger crops
+    # or batches should keep save_corr (the model default).
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
     _defaults = RAFTConfig()
     remat_policy = os.environ.get("BENCH_REMAT_POLICY",
                                   _defaults.remat_policy)
