@@ -113,6 +113,8 @@ def main():
         compute_dtype=compute_dtype, corr_impl=corr_impl,
         corr_precision=corr_precision, remat=remat,
         remat_policy=remat_policy, scan_unroll=scan_unroll,
+        lookup_block_q=int(os.environ.get("BENCH_LOOKUP_BLOCK_Q",
+                                          _defaults.lookup_block_q)),
         remat_upsample=os.environ.get("BENCH_REMAT_UPSAMPLE", "1") == "1")
     cfg = TrainConfig(num_steps=1000, batch_size=B, image_size=(H, W),
                       iters=12)

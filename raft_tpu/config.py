@@ -43,6 +43,9 @@ class RAFTConfig:
     corr_impl: str = "allpairs"
     # Pixels per block for the chunked/pallas on-demand correlation path.
     corr_block_size: int = 256
+    # Query block (grid tile) for the fused Pallas pyramid lookup
+    # (allpairs_pallas); must divide the padded query count.
+    lookup_block_q: int = 128
     # MXU precision for the correlation matmul + window-sampling einsums:
     # 'default' (1 bf16 pass), 'high' (bf16x3), 'highest' (fp32 —
     # measured FASTER than bf16x3 on v5e, and the reference keeps corr

@@ -89,7 +89,7 @@ class RefinementStep(nn.Module):
 
             corr = pallas_pyramid_lookup(corr_state, coords1,
                                          cfg.corr_radius,
-                                         min(cfg.corr_block_size, 128))
+                                         cfg.lookup_block_q)
         elif cfg.corr_impl == "pallas":
             from raft_tpu.ops.pallas_corr import pallas_corr_lookup
 
@@ -227,7 +227,7 @@ class RAFT(nn.Module):
         elif cfg.corr_impl == "allpairs_pallas":
             corr_state = build_corr_pyramid_flat(
                 fmap1, fmap2, cfg.corr_levels, cfg.corr_precision,
-                pad_q=min(cfg.corr_block_size, 128))
+                pad_q=cfg.lookup_block_q)
         elif cfg.corr_impl in ("chunked", "pallas"):
             corr_state = (fmap1, pool_fmap_pyramid(fmap2, cfg.corr_levels))
         else:

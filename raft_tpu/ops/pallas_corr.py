@@ -38,12 +38,19 @@ Blocking: queries are processed in ``block_q`` chunks (grid = (B, N/BQ));
 one fused kernel instance holds EVERY level's ``f2`` and one query block's
 rows in VMEM.  The correlation volume never exists in HBM.
 
-Toolchain caveat (round 2): the fused on-demand bodies (MXU mat-muls
-inside y-tile fori loops x 4 levels) compile correctly in interpret mode
-and pass parity/gradient tests, but Mosaic+remote compile on the current
-axon toolchain exceeded 20-40 minute budgets at both eval-720p and
-training-crop shapes, so ``corr_impl='pallas'`` is opt-in and
-``--alternate_corr`` maps to the XLA ``chunked`` path (see ROADMAP.md).
+Toolchain caveat (round 2): the fused on-demand bodies compile correctly
+in interpret mode and pass parity/gradient tests, but Mosaic+remote
+compile on the current axon toolchain exceeded 10-40 minute budgets at
+every shape tried — with the original mat-mul-per-y-tile design AND
+after hoisting to one dot per level (current code), so the mat-mul-in-
+loop hypothesis is falsified; remaining suspects are the 81-per-level
+ones-row dots and the 4-level fusion (bisection plan in ROADMAP.md).
+``corr_impl='pallas'`` is therefore opt-in and ``--alternate_corr``
+maps to the XLA ``chunked`` path.  Separate sizing note: the per-level
+correlation/drows VMEM scratch is fine at curriculum crops (<=1.5 MB)
+but at the 1440x2560 beyond-HBM target the fp32 ``f2`` levels plus
+scratch (~118 MB) exceed the 100 MB VMEM budget — serving that shape
+also needs bf16 ``f2`` blocks or a smaller ``block_q``.
 """
 
 from __future__ import annotations
@@ -69,13 +76,16 @@ def _tap_weight(c: jax.Array, offset, pos) -> jax.Array:
     return jnp.maximum(0.0, 1.0 - jnp.abs(c + offset - pos))
 
 
-def _odm_fwd_level_body(f2_ref, f1, c_ref, out_ref, lvl, off, hl, wl, k,
-                        inv_scale):
-    """One level of the fused on-demand forward: stream ``f2`` in
-    y-tiles, one (T*Wl, C) x (C, BQ) mat-mul per tile (the correlation
-    rows never exist at once, not even in VMEM), accumulate the K
-    vertical taps, contract x by a sublane reduction, and write this
-    level's ``(k*k, BQ)`` tap slice at sublane offset ``off``."""
+def _odm_fwd_level_body(f2_ref, f1, c_ref, out_ref, scratch_ref, lvl, off,
+                        hl, wl, k, inv_scale):
+    """One level of the fused on-demand forward: ONE (Hl*Wl, C) x
+    (C, BQ) mat-mul materializes this level's correlation block into a
+    VMEM scratch ref (<=1.5 MB at block_q=128), then the tap pass is
+    the same pure-VPU tile loop as the pyramid kernel.  Mat-muls must
+    stay OUT of the fori_loop bodies: the original row-streamed design
+    (a dot per y-tile) made Mosaic compile time explode past 10-minute
+    budgets even for a single standalone lookup.  (The scratch ref is
+    needed because Mosaic cannot dynamic_slice VALUES, only refs.)"""
     bq = f1.shape[0]
     r = (k - 1) // 2
     lvl_div = 1.0 / (2.0 ** lvl)
@@ -83,24 +93,26 @@ def _odm_fwd_level_body(f2_ref, f1, c_ref, out_ref, lvl, off, hl, wl, k,
     cy = c_ref[0, :, 1] * lvl_div
     posx = jax.lax.broadcasted_iota(jnp.int32, (wl, bq), 0) \
         .astype(jnp.float32)            # (Wl, BQ)
-    t_y = min(_Y_TILE, hl)
-    n_tiles = hl // t_y
     C = f1.shape[-1]
 
-    def _tile_taps(y0f, yis, f2_t, acc):
-        rows3 = (jax.lax.dot_general(
-            f2_t, f1, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-            * inv_scale).reshape(len(yis), wl, bq)
+    scratch_ref[...] = jax.lax.dot_general(
+        f2_ref[0].reshape(hl * wl, C), f1, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * inv_scale     # (Hl*Wl, BQ)
+
+    t_y = min(_Y_TILE, hl)
+    n_tiles = hl // t_y
+
+    def _tile_taps(y0f, yis, blk, acc):
         for yi in yis:
+            row = blk[yi * wl:(yi + 1) * wl, :]
             for j in range(k):
                 acc[j] += _tap_weight(cy, j - r - yi,
-                                      y0f)[None, :] * rows3[yi]
+                                      y0f)[None, :] * row
         return acc
 
     def tile_body(t, acc):
-        f2_t = f2_ref[0, pl.ds(t * t_y, t_y)].reshape(t_y * wl, C)
-        return _tile_taps((t * t_y).astype(jnp.float32), range(t_y), f2_t,
+        blk = scratch_ref[pl.ds(t * t_y * wl, t_y * wl), :]
+        return _tile_taps((t * t_y).astype(jnp.float32), range(t_y), blk,
                           acc)
 
     acc = jax.lax.fori_loop(
@@ -108,8 +120,8 @@ def _odm_fwd_level_body(f2_ref, f1, c_ref, out_ref, lvl, off, hl, wl, k,
         [jnp.zeros((wl, bq), jnp.float32) for _ in range(k)])
     if hl % t_y:  # static remainder rows
         rem = hl - hl % t_y
-        f2_t = f2_ref[0, rem:].reshape((hl - rem) * wl, C)
-        acc = _tile_taps(jnp.float32(rem), range(hl - rem), f2_t, acc)
+        acc = _tile_taps(jnp.float32(rem), range(hl - rem),
+                         scratch_ref[rem * wl:, :], acc)
 
     # Contract x with a ones-row mat-mul: Mosaic rejects this particular
     # sublane multi_reduction ("unsupported output implicit dimension")
@@ -128,21 +140,26 @@ def _odm_fwd_kernel(*refs, levels, k, kk_total, inv_scale):
     """Fused on-demand forward over every non-empty level (ONE
     pallas_call per lookup instead of one per level — the per-call
     overhead dominated the small levels).  refs =
-    [f2_0..f2_{n-1}, f1, c, out]; out: (1, L*k*k, BQ) query-minor."""
-    f1_ref, c_ref, out_ref = refs[-3], refs[-2], refs[-1]
+    [f2_0..f2_{n-1}, f1, c, out, scratch_0..scratch_{n-1}];
+    out: (1, L*k*k, BQ) query-minor."""
+    nl = len(levels)
+    f1_ref, c_ref, out_ref = refs[nl], refs[nl + 1], refs[nl + 2]
+    scratch_refs = refs[nl + 3:]
     f1 = f1_ref[0]                      # (BQ, C)
     covered = 0
-    for (lvl, off, hl, wl), f2_ref in zip(levels, refs[:-3]):
-        _odm_fwd_level_body(f2_ref, f1, c_ref, out_ref, lvl, off, hl, wl,
-                            k, inv_scale)
+    for (lvl, off, hl, wl), f2_ref, scratch_ref in zip(levels, refs[:nl],
+                                                       scratch_refs):
+        _odm_fwd_level_body(f2_ref, f1, c_ref, out_ref, scratch_ref, lvl,
+                            off, hl, wl, k, inv_scale)
         covered += k * k
     if covered < kk_total:  # empty (over-pooled) trailing levels
         out_ref[0, covered:, :] = jnp.zeros(
             (kk_total - covered, f1.shape[0]), jnp.float32)
 
 
-def _odm_bwd_level_body(f2_ref, df2_ref, f1, c_ref, g_ref, lvl, off, hl,
-                        wl, k, inv_scale, is_first_block, df1):
+def _odm_bwd_level_body(f2_ref, df2_ref, scratch_ref, f1, c_ref, g_ref,
+                        lvl, off, hl, wl, k, inv_scale, is_first_block,
+                        df1):
     """One level of the fused on-demand backward: per image row y,
     ``drows_y(x, q) = sum_ij g(i,j,q) wx_i(x,q) wy_j(y,q)`` feeds two
     mat-muls — ``df1 += drows @ f2`` and ``df2[y-tile] += drows^T-style
@@ -173,54 +190,57 @@ def _odm_bwd_level_body(f2_ref, df2_ref, f1, c_ref, g_ref, lvl, off, hl,
     t_y = min(_Y_TILE, hl)
     n_tiles = hl // t_y
 
-    def _tile_grads(y0f, yis, f2_t, df1):
-        drows = jnp.concatenate([
+    # Assemble the full (Hl*Wl, BQ) drows image into a VMEM scratch ref
+    # with a pure-VPU tile loop, then TWO mat-muls for the whole level —
+    # mat-muls in fori bodies blow up Mosaic compile time (see forward
+    # body), and Mosaic cannot dynamic_update_slice VALUES, only refs.
+    def _tile_rows(y0f, yis):
+        return jnp.concatenate([
             sum((_tap_weight(cy, tj - r - yi, y0f))[None, :] * b[tj]
                 for tj in range(k))
             for yi in yis
         ], axis=0) * inv_scale                           # (T*Wl, BQ)
-        df1 = df1 + jax.lax.dot_general(
-            drows, f2_t, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # (BQ, C)
-        df2_t = jax.lax.dot_general(
-            drows, f1, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # (T*Wl, C)
-        return df1, df2_t
 
-    def tile_body(t, df1):
-        f2_t = f2_ref[0, pl.ds(t * t_y, t_y)].reshape(t_y * wl, C)
-        df1, df2_t = _tile_grads((t * t_y).astype(jnp.float32),
-                                 range(t_y), f2_t, df1)
-        df2_ref[0, pl.ds(t * t_y, t_y)] += df2_t.reshape(t_y, wl, C)
-        return df1
+    def tile_body(t, _):
+        scratch_ref[pl.ds(t * t_y * wl, t_y * wl), :] = _tile_rows(
+            (t * t_y).astype(jnp.float32), range(t_y))
+        return 0
 
-    df1 = jax.lax.fori_loop(0, n_tiles, tile_body, df1)
+    jax.lax.fori_loop(0, n_tiles, tile_body, 0)
     if hl % t_y:  # static remainder rows
         rem = hl - hl % t_y
-        f2_t = f2_ref[0, rem:].reshape((hl - rem) * wl, C)
-        df1, df2_t = _tile_grads(jnp.float32(rem), range(hl - rem), f2_t,
-                                 df1)
-        df2_ref[0, rem:] += df2_t.reshape(hl - rem, wl, C)
+        scratch_ref[rem * wl:, :] = _tile_rows(jnp.float32(rem),
+                                               range(hl - rem))
+
+    drows = scratch_ref[...]
+    f2_flat = f2_ref[0].reshape(hl * wl, C)
+    df1 = df1 + jax.lax.dot_general(
+        drows, f2_flat, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (BQ, C)
+    df2_ref[0] += jax.lax.dot_general(
+        drows, f1, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).reshape(hl, wl, C)
     return df1
 
 
 def _odm_bwd_kernel(*refs, levels, k, inv_scale):
-    """Fused on-demand backward; refs = [f2_0.., f1, c, g, df1,
-    df2_0..].  ``df1`` accumulates across levels in registers and is
+    """Fused on-demand backward; refs = [f2_0.., f1, c, g, df1, df2_0..,
+    scratch_0..].  ``df1`` accumulates across levels in registers and is
     written once; each level's ``df2`` accumulates across query blocks
     in HBM (sequential grid)."""
     nl = len(levels)
     f1_ref, c_ref, g_ref, df1_ref = refs[nl], refs[nl + 1], refs[nl + 2], \
         refs[nl + 3]
-    df2_refs = refs[nl + 4:]
+    df2_refs = refs[nl + 4:nl + 4 + nl]
+    scratch_refs = refs[nl + 4 + nl:]
     f1 = f1_ref[0]
     is_first = pl.program_id(1) == 0
     df1 = jnp.zeros((f1.shape[0], f1.shape[1]), jnp.float32)
-    for (lvl, off, hl, wl), f2_ref, df2_ref in zip(levels, refs[:nl],
-                                                   df2_refs):
-        df1 = _odm_bwd_level_body(f2_ref, df2_ref, f1, c_ref, g_ref, lvl,
-                                  off, hl, wl, k, inv_scale, is_first,
-                                  df1)
+    for (lvl, off, hl, wl), f2_ref, df2_ref, scr in zip(
+            levels, refs[:nl], df2_refs, scratch_refs):
+        df1 = _odm_bwd_level_body(f2_ref, df2_ref, scr, f1, c_ref, g_ref,
+                                  lvl, off, hl, wl, k, inv_scale,
+                                  is_first, df1)
     df1_ref[0] = df1
 
 
@@ -588,6 +608,10 @@ def _corr_fwd(fmap1, fmap2_pyramid, coords, radius, block_q, interpret):
                                lambda b, i: (b, 0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, L * k * k, Npad), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((f2.shape[1] * f2.shape[2], block_q), jnp.float32)
+            for _, f2 in nonempty
+        ],
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
@@ -642,6 +666,10 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((f2.shape[1] * f2.shape[2], block_q), jnp.float32)
+            for _, f2 in nonempty
+        ],
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
