@@ -47,11 +47,13 @@ def main(argv=None):
     from raft_tpu.ops.pad import InputPadder
     from raft_tpu.utils.flow_viz import flow_to_image
 
+    from raft_tpu.evaluate import default_alternate_corr_impl
+
     compute_dtype = "bfloat16" if args.precision == "bf16" else "float32"
     mk = RAFTConfig.small_model if args.small else RAFTConfig.full
     model_cfg = mk(compute_dtype=compute_dtype,
-                   corr_impl="chunked" if args.alternate_corr
-                   else "allpairs")
+                   corr_impl=default_alternate_corr_impl()
+                   if args.alternate_corr else "allpairs")
     variables = load_model_variables(args.model)
     if "batch_stats" not in variables:
         variables = dict(variables, batch_stats={})

@@ -67,8 +67,8 @@ def main(argv=None):
     compute_dtype = "bfloat16" if args.precision == "bf16" else "float32"
     mk = RAFTConfig.small_model if args.small else RAFTConfig.full
     model_cfg = mk(compute_dtype=compute_dtype,
-                   corr_impl="chunked" if args.alternate_corr
-                   else "allpairs")
+                   corr_impl=evaluate.default_alternate_corr_impl()
+                   if args.alternate_corr else "allpairs")
     variables = load_model_variables(args.model)
     if "batch_stats" not in variables:
         variables = dict(variables, batch_stats={})

@@ -36,6 +36,15 @@ from raft_tpu.ops.pad import InputPadder
 from raft_tpu.utils.warp import forward_interpolate
 
 
+def default_alternate_corr_impl() -> str:
+    """The ``--alternate_corr`` implementation for this backend: the
+    fused on-demand Pallas kernels on TPU (the ``alt_cuda_corr`` analog —
+    1.13 f/s at 1440x2560 where all-pairs OOMs, 2x the chunked path),
+    the XLA chunked formulation elsewhere (interpret-mode Pallas is
+    impractically slow on CPU)."""
+    return "pallas" if jax.default_backend() == "tpu" else "chunked"
+
+
 def make_eval_fn(model_cfg: RAFTConfig, iters: int):
     """Jitted ``(variables, image1, image2, flow_init) -> (flow_low,
     flow_up)`` test-mode forward.  ``flow_init`` may be None (traced as a

@@ -38,19 +38,23 @@ Blocking: queries are processed in ``block_q`` chunks (grid = (B, N/BQ));
 one fused kernel instance holds EVERY level's ``f2`` and one query block's
 rows in VMEM.  The correlation volume never exists in HBM.
 
-Toolchain caveat (round 2): the fused on-demand bodies compile correctly
-in interpret mode and pass parity/gradient tests, but Mosaic+remote
-compile on the current axon toolchain exceeded 10-40 minute budgets at
-every shape tried — with the original mat-mul-per-y-tile design AND
-after hoisting to one dot per level (current code), so the mat-mul-in-
-loop hypothesis is falsified; remaining suspects are the 81-per-level
-ones-row dots and the 4-level fusion (bisection plan in ROADMAP.md).
-``corr_impl='pallas'`` is therefore opt-in and ``--alternate_corr``
-maps to the XLA ``chunked`` path.  Separate sizing note: the per-level
-correlation/drows VMEM scratch is fine at curriculum crops (<=1.5 MB)
-but at the 1440x2560 beyond-HBM target the fp32 ``f2`` levels plus
-scratch (~118 MB) exceed the 100 MB VMEM budget — serving that shape
-also needs bf16 ``f2`` blocks or a smaller ``block_q``.
+Compile-time lesson (round 2, RESOLVED): the original kernels took
+>10-40 minutes of Mosaic+remote compile at every shape.  The cause was
+1-D vector layouts — deriving ``cx/cy`` as ``(BQ,)`` vectors gives
+Mosaic "implicit dimension" layouts whose reductions it either rejects
+("unsupported output implicit dimension") or compiles pathologically
+slowly.  With every tap-center kept 2-D ``(1, BQ)`` (coords passed
+query-minor ``(B, 2, Npad)``, exactly like the pyramid kernels), the
+fused forward compiles in ~3 s and the backward in ~8 s.  Two related
+Mosaic constraints learned on the way and kept in the code: mat-muls
+must stay OUT of fori_loop bodies (one hoisted dot per level into a
+VMEM scratch ref), and values cannot be dynamic_slice'd — tile passes
+over materialized blocks need scratch REFS.
+
+VMEM sizing: beyond-HBM shapes auto-drop the ``f2`` blocks to bf16
+(fp32 accumulation) once fp32 ``f2`` + correlation scratch would
+exceed ~48 MB (``_odm_f2_dtype``) — at the 1440x2560 target the fp32
+form (~118 MB) cannot fit the budget.
 """
 
 from __future__ import annotations
@@ -89,14 +93,19 @@ def _odm_fwd_level_body(f2_ref, f1, c_ref, out_ref, scratch_ref, lvl, off,
     bq = f1.shape[0]
     r = (k - 1) // 2
     lvl_div = 1.0 / (2.0 ** lvl)
-    cx = c_ref[0, :, 0] * lvl_div       # (BQ,)
-    cy = c_ref[0, :, 1] * lvl_div
+    # Keep the tap centers 2-D (1, BQ): Mosaic represents 1-D vectors
+    # with an implicit dim and rejects reductions mixing those layouts
+    # ("unsupported output implicit dimension") — the pyramid kernel
+    # compiles exactly this math with everything 2-D.
+    cx = c_ref[0, 0:1, :] * lvl_div     # (1, BQ)
+    cy = c_ref[0, 1:2, :] * lvl_div
     posx = jax.lax.broadcasted_iota(jnp.int32, (wl, bq), 0) \
         .astype(jnp.float32)            # (Wl, BQ)
     C = f1.shape[-1]
 
+    f2m = f2_ref[0].reshape(hl * wl, C)
     scratch_ref[...] = jax.lax.dot_general(
-        f2_ref[0].reshape(hl * wl, C), f1, (((1,), (1,)), ((), ())),
+        f2m, f1.astype(f2m.dtype), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * inv_scale     # (Hl*Wl, BQ)
 
     t_y = min(_Y_TILE, hl)
@@ -106,8 +115,7 @@ def _odm_fwd_level_body(f2_ref, f1, c_ref, out_ref, scratch_ref, lvl, off,
         for yi in yis:
             row = blk[yi * wl:(yi + 1) * wl, :]
             for j in range(k):
-                acc[j] += _tap_weight(cy, j - r - yi,
-                                      y0f)[None, :] * row
+                acc[j] += _tap_weight(cy, j - r - yi, y0f) * row
         return acc
 
     def tile_body(t, acc):
@@ -123,12 +131,14 @@ def _odm_fwd_level_body(f2_ref, f1, c_ref, out_ref, scratch_ref, lvl, off,
         acc = _tile_taps(jnp.float32(rem), range(hl - rem),
                          scratch_ref[rem * wl:, :], acc)
 
-    # Contract x with a ones-row mat-mul: Mosaic rejects this particular
-    # sublane multi_reduction ("unsupported output implicit dimension")
-    # at on-demand shapes, but (1, Wl) @ (Wl, BQ) is plain MXU.
+    # Contract x with a ones-row mat-mul: the keepdims sublane-sum form
+    # hits Mosaic "unsupported output implicit dimension" at some widths
+    # (observed at wl=120 — a common 960-px-frame level-0 width) while
+    # (1, Wl) @ (Wl, BQ) compiles at every width; with the 2-D tap-center
+    # layouts above, compile time is seconds either way.
     ones_row = jnp.ones((1, wl), jnp.float32)
     for i in range(k):
-        wx_i = _tap_weight(cx[None, :], float(i - r), posx)  # (Wl, BQ)
+        wx_i = _tap_weight(cx, float(i - r), posx)  # (Wl, BQ)
         for j in range(k):
             out_ref[0, off + i * k + j:off + i * k + j + 1, :] = \
                 jax.lax.dot_general(
@@ -169,14 +179,14 @@ def _odm_bwd_level_body(f2_ref, df2_ref, scratch_ref, f1, c_ref, g_ref,
     bq = f1.shape[0]
     r = (k - 1) // 2
     lvl_div = 1.0 / (2.0 ** lvl)
-    cx = c_ref[0, :, 0] * lvl_div
-    cy = c_ref[0, :, 1] * lvl_div
+    cx = c_ref[0, 0:1, :] * lvl_div     # (1, BQ) — 2-D, see fwd body
+    cy = c_ref[0, 1:2, :] * lvl_div
     posx = jax.lax.broadcasted_iota(jnp.int32, (wl, bq), 0) \
         .astype(jnp.float32)
 
     # b_j(x, q) = sum_i wx_i(x, q) g(i*k+j, q)
     b = [
-        sum(_tap_weight(cx[None, :], float(ti - r), posx)
+        sum(_tap_weight(cx, float(ti - r), posx)
             * g_ref[0, off + ti * k + tj:off + ti * k + tj + 1, :]
             for ti in range(k))
         for tj in range(k)
@@ -196,7 +206,7 @@ def _odm_bwd_level_body(f2_ref, df2_ref, scratch_ref, f1, c_ref, g_ref,
     # body), and Mosaic cannot dynamic_update_slice VALUES, only refs.
     def _tile_rows(y0f, yis):
         return jnp.concatenate([
-            sum((_tap_weight(cy, tj - r - yi, y0f))[None, :] * b[tj]
+            sum(_tap_weight(cy, tj - r - yi, y0f) * b[tj]
                 for tj in range(k))
             for yi in yis
         ], axis=0) * inv_scale                           # (T*Wl, BQ)
@@ -213,7 +223,7 @@ def _odm_bwd_level_body(f2_ref, df2_ref, scratch_ref, f1, c_ref, g_ref,
                                                range(hl - rem))
 
     drows = scratch_ref[...]
-    f2_flat = f2_ref[0].reshape(hl * wl, C)
+    f2_flat = f2_ref[0].reshape(hl * wl, C).astype(jnp.float32)
     df1 = df1 + jax.lax.dot_general(
         drows, f2_flat, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)              # (BQ, C)
@@ -574,6 +584,29 @@ def _odm_levels(fmap2_pyramid, k):
     return nonempty, levels
 
 
+def _odm_f2_dtype(nonempty, block_q):
+    """fp32 f2 blocks whenever they fit the VMEM budget; bf16 beyond.
+
+    At beyond-HBM shapes (the path's whole purpose — e.g. 1440x2560,
+    where fp32 f2 + correlation scratch is ~118 MB against the 100 MB
+    budget) bf16 f2 (fp32 accumulation via preferred_element_type)
+    halves the resident footprint.  The threshold models the actual
+    per-instance residency — f2 levels + per-level scratch + the query
+    blocks — against the declared 100 MB limit with headroom for
+    double-buffered block DMA, so fp32 is kept as long as it genuinely
+    fits (1080p-class included)."""
+    if not nonempty:
+        return jnp.float32
+    C = nonempty[0][1].shape[-1]
+    rows = sum(f2.shape[1] * f2.shape[2] for _, f2 in nonempty)
+    f2_bytes = rows * C * 4
+    scratch_bytes = rows * block_q * 4
+    blocks_bytes = 4 * block_q * (2 * C + 2 + 81 * len(nonempty)) * 2
+    if f2_bytes + scratch_bytes + blocks_bytes > 88 * 1024 * 1024:
+        return jnp.bfloat16
+    return jnp.float32
+
+
 def _corr_fwd(fmap1, fmap2_pyramid, coords, radius, block_q, interpret):
     if interpret is None:
         interpret = _auto_interpret()
@@ -587,6 +620,7 @@ def _corr_fwd(fmap1, fmap2_pyramid, coords, radius, block_q, interpret):
     Npad = f1p.shape[1]
 
     nonempty, levels = _odm_levels(fmap2_pyramid, k)
+    f2dt = _odm_f2_dtype(nonempty, block_q)
     kern = functools.partial(_odm_fwd_kernel, levels=levels, k=k,
                              kk_total=L * k * k,
                              inv_scale=1.0 / float(C) ** 0.5)
@@ -597,7 +631,7 @@ def _corr_fwd(fmap1, fmap2_pyramid, coords, radius, block_q, interpret):
     ] + [
         pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+        pl.BlockSpec((1, 2, block_q), lambda b, i: (b, 0, i),
                      memory_space=pltpu.VMEM),
     ]
     out = pl.pallas_call(
@@ -615,7 +649,8 @@ def _corr_fwd(fmap1, fmap2_pyramid, coords, radius, block_q, interpret):
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(*[f2.astype(jnp.float32) for _, f2 in nonempty], f1p, cp)
+    )(*[f2.astype(f2dt) for _, f2 in nonempty], f1p,
+      cp.transpose(0, 2, 1))
     out = out[:, :, :N].reshape(B, L * k * k, H1, W1).transpose(0, 2, 3, 1)
     return out, (fmap1, tuple(fmap2_pyramid), coords)
 
@@ -638,6 +673,7 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
         g = jnp.pad(g, ((0, 0), (0, 0), (0, Npad - N)))
 
     nonempty, levels = _odm_levels(fmap2_pyramid, k)
+    f2dt = _odm_f2_dtype(nonempty, block_q)
     kern = functools.partial(_odm_bwd_kernel, levels=levels, k=k,
                              inv_scale=1.0 / float(C) ** 0.5)
     in_specs = [
@@ -647,7 +683,7 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
     ] + [
         pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, block_q, 2), lambda b, i: (b, i, 0),
+        pl.BlockSpec((1, 2, block_q), lambda b, i: (b, 0, i),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, L * k * k, block_q), lambda b, i: (b, 0, i),
                      memory_space=pltpu.VMEM),
@@ -673,7 +709,8 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(*[f2.astype(jnp.float32) for _, f2 in nonempty], f1p, cp, g)
+    )(*[f2.astype(f2dt) for _, f2 in nonempty], f1p,
+      cp.transpose(0, 2, 1), g)
 
     df1 = outs[0][:, :N].reshape(fmap1.shape).astype(fmap1.dtype)
     df2s = []
