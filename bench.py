@@ -111,11 +111,19 @@ def main():
     compute_dtype = os.environ.get("BENCH_COMPUTE_DTYPE", "bfloat16")
     model_cfg = RAFTConfig.full(
         compute_dtype=compute_dtype, corr_impl=corr_impl,
-        corr_precision=corr_precision, remat=remat,
+        corr_precision=corr_precision,
+        corr_dtype=os.environ.get("BENCH_CORR_DTYPE", _defaults.corr_dtype),
+        remat=remat,
         remat_policy=remat_policy, scan_unroll=scan_unroll,
         lookup_block_q=int(os.environ.get("BENCH_LOOKUP_BLOCK_Q",
                                           _defaults.lookup_block_q)),
-        remat_upsample=os.environ.get("BENCH_REMAT_UPSAMPLE", "1") == "1")
+        remat_upsample=os.environ.get("BENCH_REMAT_UPSAMPLE", "1") == "1",
+        upsample_group=int(os.environ.get("BENCH_UPSAMPLE_GROUP",
+                                          _defaults.upsample_group)),
+        upsample_unroll=int(os.environ.get("BENCH_UPSAMPLE_UNROLL",
+                                           _defaults.upsample_unroll)),
+        upsample_dtype=os.environ.get("BENCH_UPSAMPLE_DTYPE",
+                                      _defaults.upsample_dtype))
     cfg = TrainConfig(num_steps=1000, batch_size=B, image_size=(H, W),
                       iters=12)
 

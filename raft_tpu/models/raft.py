@@ -161,22 +161,43 @@ class UpsampleLossStep(nn.Module):
     @nn.compact
     def __call__(self, carry, net, flow, gt128, vmask64):
         cfg = self.config
+        udt = jnp.dtype(cfg.resolved_upsample_dtype)
         B = gt128.shape[0]
         g = net.shape[0] // B
         mask = MaskHead(cfg.hidden_dim, cfg.dtype, name="mask_head")(net)
-        out = convex_upsample_flat(flow, mask)        # (gB, H, W, 128)
-        out = out.reshape((g, B) + out.shape[1:])
+        out = convex_upsample_flat(flow, mask,
+                                   compute_dtype=udt)  # (gB, H, W, 128)
+        # The ground-truth COMPARE always runs fp32: with both sides in
+        # bf16, |out - gt| under ~0.2% of the flow magnitude rounds both
+        # operands to the same value (bf16 ulp at a 400-px KITTI flow is
+        # 2 px), dx becomes exactly 0 and those pixels stop producing L1
+        # gradient — sub-pixel convergence would stall exactly where
+        # RAFT's precision matters.  With gt full-precision, out's own
+        # rounding only SHIFTS dx (sign noise ~1 ulp, no dead zone).
+        # The expensive part (the 9-tap softmax/FMA chain) still runs in
+        # ``udt``.
+        out = out.astype(jnp.float32).reshape((g, B) + out.shape[1:])
         dx = out[..., :64] - gt128[None, ..., :64]
         dy = out[..., 64:] - gt128[None, ..., 64:]
         vm = vmask64[None]
-        l1 = jnp.sum(vm * (jnp.abs(dx) + jnp.abs(dy)), axis=(1, 2, 3, 4))
+        # Sums always accumulate fp32 (5.8M terms at training shapes —
+        # bf16 accumulation would lose the loss signal entirely).
+        def _fsum(x):
+            return jnp.sum(x, axis=(1, 2, 3, 4), dtype=jnp.float32)
+        l1 = _fsum(vm * (jnp.abs(dx) + jnp.abs(dy)))
+        # Metrics need no gradient; without stop_gradient the sqrt's
+        # derivative at exactly-zero dx²+dy² injects inf·0 = NaN into
+        # the remat'd backward even though the metric cotangents are
+        # zero.
+        dx = jax.lax.stop_gradient(dx)
+        dy = jax.lax.stop_gradient(dy)
         epe = jnp.sqrt(dx * dx + dy * dy)
         sums = jnp.stack([
             l1,
-            jnp.sum(vm * epe, axis=(1, 2, 3, 4)),
-            jnp.sum(vm * (epe < 1.0), axis=(1, 2, 3, 4)),
-            jnp.sum(vm * (epe < 3.0), axis=(1, 2, 3, 4)),
-            jnp.sum(vm * (epe < 5.0), axis=(1, 2, 3, 4)),
+            _fsum(vm * epe),
+            _fsum(vm * (epe < 1.0)),
+            _fsum(vm * (epe < 3.0)),
+            _fsum(vm * (epe < 5.0)),
         ], axis=-1)                                   # (g, 5)
         return carry, sums
 
@@ -227,7 +248,8 @@ class RAFT(nn.Module):
         elif cfg.corr_impl == "allpairs_pallas":
             corr_state = build_corr_pyramid_flat(
                 fmap1, fmap2, cfg.corr_levels, cfg.corr_precision,
-                pad_q=cfg.lookup_block_q)
+                pad_q=cfg.lookup_block_q,
+                out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
         elif cfg.corr_impl in ("chunked", "pallas"):
             corr_state = (fmap1, pool_fmap_pyramid(fmap2, cfg.corr_levels))
         else:
@@ -297,7 +319,12 @@ class RAFT(nn.Module):
         # stacked (iters, B, H/8, W/8, hdim) GRU states and recomputes two
         # convs + a softmax per group.
         I = iters
-        g = next((g for g in (2, 1) if I % g == 0))
+        # Largest divisor of I that is <= upsample_group (clamped to
+        # [1, I] so misconfigured knobs degrade instead of raising a
+        # bare StopIteration from inside the trace).
+        g = next(g for g in range(max(1, min(cfg.upsample_group, I)), 0,
+                                  -1)
+                 if I % g == 0)
         nets_r = nets.reshape((I // g, g * B) + nets.shape[2:])
         flows_r = flows.reshape((I // g, g * B) + flows.shape[2:])
 
@@ -320,6 +347,7 @@ class RAFT(nn.Module):
                 in_axes=(0, 0, nn.broadcast, nn.broadcast),
                 out_axes=0,
                 length=I // g,
+                unroll=max(1, min(cfg.upsample_unroll, I // g)),
             )(cfg, name="upsampler")
             _, sums = up_scan(None, nets_r, flows_r, gt128, vmask64)
             sums = sums.reshape(I, 5)
@@ -343,6 +371,7 @@ class RAFT(nn.Module):
             in_axes=0,
             out_axes=0,
             length=I // g,
+            unroll=max(1, min(cfg.upsample_unroll, I // g)),
         )(cfg, name="upsampler")
         _, flow_ups = up_scan(None, nets_r, flows_r)
         flow_ups = flow_ups.reshape((I, B) + flow_ups.shape[2:])

@@ -110,7 +110,8 @@ def _avg_pool_2x2_qminor(x: jax.Array) -> jax.Array:
 
 def build_corr_pyramid_flat(fmap1: jax.Array, fmap2: jax.Array,
                             num_levels: int = 4, precision="highest",
-                            pad_q: int = 128) -> List[jax.Array]:
+                            pad_q: int = 128,
+                            out_dtype=jnp.float32) -> List[jax.Array]:
     """Materialized pyramid in QUERY-MINOR layout: level l is
     ``(B, H/2^l, W/2^l, Npad)`` with the flattened query dim zero-padded
     to a multiple of ``pad_q``.
@@ -134,10 +135,12 @@ def build_corr_pyramid_flat(fmap1: jax.Array, fmap2: jax.Array,
                       precision=resolve_precision(precision),
                       preferred_element_type=jnp.float32)
     corr = corr / jnp.sqrt(jnp.float32(C))
-    pyramid = [corr]
+    # Pyramid math (pooling) stays fp32; only the STORED levels round to
+    # ``out_dtype`` (XLA fuses the casts into the einsum/pool epilogues).
+    pyramid = [corr.astype(out_dtype)]
     for _ in range(num_levels - 1):
         corr = _avg_pool_2x2_qminor(corr)
-        pyramid.append(corr)
+        pyramid.append(corr.astype(out_dtype))
     return pyramid
 
 

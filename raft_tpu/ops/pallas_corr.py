@@ -323,7 +323,10 @@ def _pyr_fwd_level_body(corr_ref, c_ref, out_ref, lvl, out_off, hl, wl, k):
         blk = corr_ref[0, pl.ds(t * T, T), :, :]     # (T, wl, BQ)
         y0 = (t * T).astype(jnp.float32)
         for yi in range(T):
-            row = blk[yi, :, :]
+            # fp32 accumulation regardless of the stored pyramid dtype
+            # (corr_dtype='bfloat16' halves the HBM read traffic; the
+            # convert rides the VMEM load).
+            row = blk[yi, :, :].astype(jnp.float32)
             for j in range(k):
                 accs[j] += _tap_weight(cy, float(j - r - yi), y0) * row
         return accs
@@ -335,7 +338,7 @@ def _pyr_fwd_level_body(corr_ref, c_ref, out_ref, lvl, out_off, hl, wl, k):
         rem = nt * T
         blk = corr_ref[0, rem:, :, :]
         for yi in range(hl - rem):
-            row = blk[yi, :, :]
+            row = blk[yi, :, :].astype(jnp.float32)
             for j in range(k):
                 accs[j] += _tap_weight(cy, float(j - r - yi),
                                        float(rem)) * row
@@ -374,13 +377,14 @@ def _pyr_bwd_level_body(c_ref, g_ref, dcorr_ref, lvl, g_off, hl, wl, k):
 
     def tile_body(t, _):
         dcorr_ref[0, pl.ds(t * T, T), :, :] = _rows(
-            (t * T).astype(jnp.float32), range(T))
+            (t * T).astype(jnp.float32), range(T)).astype(dcorr_ref.dtype)
         return 0
 
     jax.lax.fori_loop(0, nt, tile_body, 0)
     if hl % T:
         rem = nt * T
-        dcorr_ref[0, rem:, :, :] = _rows(float(rem), range(hl - rem))
+        dcorr_ref[0, rem:, :, :] = _rows(
+            float(rem), range(hl - rem)).astype(dcorr_ref.dtype)
 
 
 def _pyr_multi_fwd_kernel(*refs, levels, k, kk_total):
@@ -454,10 +458,10 @@ def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
     B, _, Npad = coords_p.shape
     k = 2 * radius + 1
     dpyr = []
-    for lvl, s in enumerate(shapes):
+    for lvl, (s, dt) in enumerate(shapes):
         hl, wl = s[1], s[2]
         if hl == 0 or wl == 0:
-            dpyr.append(jnp.zeros(s, jnp.float32))
+            dpyr.append(jnp.zeros(s, dt))
             continue
         kern = functools.partial(_pyr_multi_bwd_kernel,
                                  levels=[(lvl, lvl * k * k, hl, wl)], k=k)
@@ -474,7 +478,7 @@ def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
             out_specs=pl.BlockSpec((1, hl, wl, block_q),
                                    lambda b, i: (b, 0, 0, i),
                                    memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((B, hl, wl, Npad), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((B, hl, wl, Npad), dt),
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=interpret,
@@ -492,7 +496,10 @@ def pallas_pyramid_lookup(pyramid, coords, radius: int = 4,
     contract, same zeros-padding bilinear semantics.
 
     Args:
-      pyramid: list of ``(B, Hl, Wl, Npad)`` fp32 QUERY-MINOR levels
+      pyramid: list of ``(B, Hl, Wl, Npad)`` QUERY-MINOR levels (fp32 or
+        bf16 storage — see ``RAFTConfig.corr_dtype``; taps always
+        accumulate fp32 in-kernel and cotangents match each level's
+        stored dtype)
         (from :func:`raft_tpu.ops.corr.build_corr_pyramid_flat`) whose
         query dim is already padded to a multiple of ``block_q`` (zero
         fmap1 rows correlate to zero).
@@ -523,12 +530,16 @@ def _pyr_fwd(pyramid, coords, radius, block_q, interpret):
                         Npad).transpose(0, 2, 1)
     out = _pyr_levels_fwd(list(pyramid), c, radius, block_q, interpret)
     out = out[:, :, :N].reshape(B, len(pyramid) * k * k, H1, W1)
+    # The bwd needs each level's shape AND stored dtype (cotangents must
+    # match the primal dtypes, which may differ per level); dtypes aren't
+    # valid residual leaves, so carry a zero-size prototype per level.
     return (out.transpose(0, 2, 3, 1),
-            (tuple(x.shape for x in pyramid), coords))
+            (tuple(x.shape for x in pyramid),
+             tuple(jnp.zeros((0,), x.dtype) for x in pyramid), coords))
 
 
 def _pyr_bwd(radius, block_q, interpret, residuals, g):
-    shapes, coords = residuals
+    shapes, protos, coords = residuals
     if interpret is None:
         interpret = _auto_interpret()
     B, H1, W1, _ = coords.shape
@@ -546,7 +557,9 @@ def _pyr_bwd(radius, block_q, interpret, residuals, g):
         g = jnp.pad(g, ((0, 0), (0, 0), (0, Npad - N)))
     # container must match the primal's (build_corr_pyramid_flat returns a
     # list)
-    dpyr = _pyr_levels_bwd(c, g, list(shapes), radius, block_q, interpret)
+    dpyr = _pyr_levels_bwd(c, g,
+                           [(s, p.dtype) for s, p in zip(shapes, protos)],
+                           radius, block_q, interpret)
     return dpyr, jnp.zeros_like(coords)
 
 

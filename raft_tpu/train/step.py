@@ -67,7 +67,9 @@ def make_train_step(model: RAFT, tx: optax.GradientTransformation,
                       rngs={"dropout": rng}, mutable=mutable)
         if cfg.fused_loss:
             # Sequence loss fused into the scan: per-iteration scalars
-            # instead of stacked full-res flows (same numerics).
+            # instead of stacked full-res flows (identical numerics at
+            # fp32; bf16-rounding-level difference when
+            # resolved_upsample_dtype is bfloat16).
             kwargs["loss_targets"] = (batch["flow"], batch["valid"],
                                       cfg.max_flow)
         out = model.apply(variables, batch["image1"], batch["image2"],

@@ -156,6 +156,34 @@ def test_pyramid_lookup_grads_match_xla():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_pyramid_lookup_bf16_storage_close_and_grad_dtype():
+    """corr_dtype='bfloat16' (bf16-stored pyramid, fp32 in-kernel
+    accumulation): values track the fp32 path within bf16 rounding and
+    the custom_vjp returns bf16 cotangents matching the primal dtype."""
+    from raft_tpu.ops.corr import build_corr_pyramid_flat
+    from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup
+
+    f1, f2, coords = _setup(6)
+    want = np.asarray(
+        corr_lookup(build_corr_pyramid(f1, f2, LEVELS), coords, RADIUS))
+    pyr16 = build_corr_pyramid_flat(f1, f2, LEVELS, pad_q=64,
+                                    out_dtype=jnp.bfloat16)
+    assert all(p.dtype == jnp.bfloat16 for p in pyr16)
+    got = np.asarray(pallas_pyramid_lookup(pyr16, coords, RADIUS, 64))
+    # corr values are O(sqrt(C)); bf16 storage rounds at ~0.4% relative,
+    # and each tap mixes <= 4 * levels stored values.
+    np.testing.assert_allclose(got, want, rtol=0.02, atol=0.05)
+
+    def loss(pyr):
+        return jnp.sum(jnp.sin(pallas_pyramid_lookup(pyr, coords, RADIUS,
+                                                     64)))
+
+    dpyr = jax.grad(loss)(pyr16)
+    assert all(d.dtype == jnp.bfloat16 for d in dpyr)
+    assert all(bool(jnp.isfinite(d.astype(jnp.float32)).all())
+               for d in dpyr)
+
+
 def test_model_allpairs_pallas_matches_allpairs():
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models.raft import RAFT
