@@ -5,6 +5,6 @@
 set -e
 mkdir -p checkpoints
 python -u -m raft_tpu.cli.train --name raft-chairs --stage chairs --validation chairs --num_steps 120000 --batch_size 8 --lr 0.00025 --image_size 368 496 --wdecay 0.0001
-python -u -m raft_tpu.cli.train --name raft-things --stage things --validation sintel --restore_ckpt checkpoints/raft-chairs --num_steps 120000 --batch_size 5 --lr 0.0001 --image_size 400 720 --wdecay 0.0001 --corr_impl chunked
+python -u -m raft_tpu.cli.train --name raft-things --stage things --validation sintel --restore_ckpt checkpoints/raft-chairs --num_steps 120000 --batch_size 5 --lr 0.0001 --image_size 400 720 --wdecay 0.0001
 python -u -m raft_tpu.cli.train --name raft-sintel --stage sintel --validation sintel --restore_ckpt checkpoints/raft-things --num_steps 120000 --batch_size 5 --lr 0.0001 --image_size 368 768 --wdecay 0.00001 --gamma 0.85
 python -u -m raft_tpu.cli.train --name raft-kitti --stage kitti --validation kitti --restore_ckpt checkpoints/raft-sintel --num_steps 50000 --batch_size 5 --lr 0.0001 --image_size 288 960 --wdecay 0.00001 --gamma 0.85
