@@ -448,24 +448,29 @@ def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret):
 
 
 def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
-    """Per-level transpose calls; ``g``: (B, L*k*k, Npad), levels
-    query-minor (B, hl, wl, Npad).  Unlike the forward, the backwards
-    stay SEPARATE pallas_calls: one fused call producing all four dcorr
-    outputs (537+134+33+8 MB at chairs batch 16) pins the whole 712 MB
-    group live per unrolled iteration and OOMs — per-level calls let
-    XLA's scheduler interleave each level's accumulation and retire the
-    temps early."""
+    """Grouped transpose calls; ``g``: (B, L*k*k, Npad), levels
+    query-minor (B, hl, wl, Npad).  Unlike the forward, level 0 stays
+    its OWN pallas_call: one call producing all four dcorr outputs pins
+    the whole group (537+134+33+8 MB fp32 at chairs batch 16) live per
+    unrolled iteration and OOMs — keeping the big level separate lets
+    XLA's scheduler interleave its accumulation and retire the temp
+    early.  The SMALL levels (1..) are fused into one call: profiled at
+    ~0.35 ms/call with near-zero math, they were pure per-call overhead
+    (48 bwd calls/step at unroll 12), and their combined liveness is
+    <15% of level 0's."""
     B, _, Npad = coords_p.shape
     k = 2 * radius + 1
-    dpyr = []
-    for lvl, (s, dt) in enumerate(shapes):
-        hl, wl = s[1], s[2]
-        if hl == 0 or wl == 0:
-            dpyr.append(jnp.zeros(s, dt))
-            continue
-        kern = functools.partial(_pyr_multi_bwd_kernel,
-                                 levels=[(lvl, lvl * k * k, hl, wl)], k=k)
-        dpyr.append(pl.pallas_call(
+    nonempty = [(lvl, s, dt) for lvl, (s, dt) in enumerate(shapes)
+                if s[1] and s[2]]
+    # [[level0], [level1..]] — singleton groups when only one level.
+    groups = [nonempty[:1]] + ([nonempty[1:]] if nonempty[1:] else [])
+    by_level = {}
+    for grp in groups:
+        kern = functools.partial(
+            _pyr_multi_bwd_kernel,
+            levels=[(lvl, lvl * k * k, s[1], s[2]) for lvl, s, _ in grp],
+            k=k)
+        outs = pl.pallas_call(
             kern,
             grid=(B, Npad // block_q),
             in_specs=[
@@ -475,15 +480,24 @@ def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
                              lambda b, i: (b, 0, i),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec((1, hl, wl, block_q),
-                                   lambda b, i: (b, 0, 0, i),
-                                   memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((B, hl, wl, Npad), dt),
+            out_specs=[
+                pl.BlockSpec((1, s[1], s[2], block_q),
+                             lambda b, i: (b, 0, 0, i),
+                             memory_space=pltpu.VMEM)
+                for _, s, _ in grp
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, s[1], s[2], Npad), dt)
+                for _, s, dt in grp
+            ],
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=interpret,
-        )(coords_p, g))
-    return dpyr
+        )(coords_p, g)
+        for (lvl, _, _), out in zip(grp, outs):
+            by_level[lvl] = out
+    return [by_level.get(lvl, jnp.zeros(s, dt))
+            for lvl, (s, dt) in enumerate(shapes)]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
