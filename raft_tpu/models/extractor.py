@@ -21,7 +21,9 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from raft_tpu.models.layers import BottleneckBlock, Norm, ResidualBlock, conv
+from raft_tpu.models.layers import (BottleneckBlock, FoldedResidualBlock,
+                                    Norm, ResidualBlock, conv, fold_w,
+                                    unfold_w)
 
 
 class BasicEncoder(nn.Module):
@@ -29,6 +31,11 @@ class BasicEncoder(nn.Module):
     norm: str = "batch"
     dropout: float = 0.0
     dtype: Any = jnp.float32
+    # Run the 64-channel layer1 stage in folded-width layout (column
+    # pairs packed into channels -> lane-dense (8, 128) tiles; same math,
+    # same param tree — see layers.fold_w).  Auto-disabled when the
+    # /2-res width is odd or the norm mode can't fold.
+    fold_layer1: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False, freeze_bn: bool = False):
@@ -40,8 +47,19 @@ class BasicEncoder(nn.Module):
             x, train, freeze_bn)
         x = nn.relu(x)
 
-        for i, (planes, stride) in enumerate(
-                [(64, 1), (64, 1), (96, 2), (96, 1), (128, 2), (128, 1)]):
+        stages = [(64, 1), (64, 1), (96, 2), (96, 1), (128, 2), (128, 1)]
+        folded = (self.fold_layer1 and x.shape[2] % 2 == 0
+                  and self.norm in ("instance", "batch", "none"))
+        start = 0
+        if folded:
+            x = fold_w(x)
+            for i in range(2):
+                x = FoldedResidualBlock(64, self.norm, dt,
+                                        name=f"layer1_{i}")(
+                    x, train, freeze_bn)
+            x = unfold_w(x)
+            start = 2
+        for i, (planes, stride) in enumerate(stages[start:], start=start):
             x = ResidualBlock(planes, self.norm, stride, dt,
                               name=f"layer{i // 2 + 1}_{i % 2}")(
                 x, train, freeze_bn)

@@ -46,3 +46,89 @@ def test_torch_default_init_statistics():
     assert np.abs(b).max() <= bound + 1e-6
     # roughly uniform: std of U(-b, b) is b/sqrt(3)
     assert abs(w.std() - bound / np.sqrt(3)) < 0.05 * bound
+
+
+# ---------------------------------------------------------------------------
+# Folded-width layer1 (lane-dense TPU layout; same math, same param tree)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("norm", ["instance", "batch", "none"])
+def test_folded_residual_block_matches_unfolded(norm):
+    from raft_tpu.models.layers import (FoldedResidualBlock, ResidualBlock,
+                                        fold_w, unfold_w)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 8, 12, 64)), jnp.float32)
+
+    ref = ResidualBlock(64, norm, 1)
+    v = ref.init(jax.random.PRNGKey(0), x, False, False)
+    want = ref.apply(v, x, False, False)
+
+    fold = FoldedResidualBlock(64, norm)
+    # identical param tree: the unfolded variables must load directly
+    got = unfold_w(fold.apply(v, fold_w(x), False, False))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_folded_batch_norm_training_stats_match():
+    """Training mode: batch stats + running-stat updates must match
+    nn.BatchNorm through the folded layout."""
+    from raft_tpu.models.layers import (FoldedResidualBlock, ResidualBlock,
+                                        fold_w, unfold_w)
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 8, 12, 64)) * 3 + 1,
+                    jnp.float32)
+    ref = ResidualBlock(64, "batch", 1)
+    v = ref.init(jax.random.PRNGKey(0), x, True, False)
+    want, wvars = ref.apply(v, x, True, False,
+                            mutable=["batch_stats"])
+
+    fold = FoldedResidualBlock(64, "batch")
+    got, gvars = fold.apply(v, fold_w(x), True, False,
+                            mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(unfold_w(got)),
+                               np.asarray(want), rtol=2e-5, atol=2e-5)
+    wl = jax.tree_util.tree_leaves_with_path(wvars)
+    gl = {jax.tree_util.keystr(p): l
+          for p, l in jax.tree_util.tree_leaves_with_path(gvars)}
+    assert gl
+    for p, leaf in wl:
+        np.testing.assert_allclose(np.asarray(gl[jax.tree_util.keystr(p)]),
+                                   np.asarray(leaf), rtol=2e-5, atol=2e-5)
+
+
+def test_encoder_folded_matches_unfolded_and_gradients():
+    from raft_tpu.models.extractor import BasicEncoder
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.uniform(-1, 1, (2, 32, 40, 3)), jnp.float32)
+    enc_f = BasicEncoder(128, "instance", 0.0)
+    enc_u = BasicEncoder(128, "instance", 0.0, fold_layer1=False)
+    v = enc_f.init(jax.random.PRNGKey(0), x, False, False)
+    yf = enc_f.apply(v, x, False, False)
+    yu = enc_u.apply(v, x, False, False)
+    np.testing.assert_allclose(np.asarray(yf), np.asarray(yu),
+                               rtol=5e-5, atol=5e-5)
+
+    # Gradients compare in float64, where the fold must be EXACT: at
+    # fp32, reduction reorder wobbles near-zero pre-activations and
+    # flips relu gates, discretely jumping individual gradient leaves by
+    # O(1%) — noise, but impossible to bound tightly.  fp64 removes the
+    # wobble and pins the math itself (observed ~1e-12).
+    with jax.enable_x64(True):
+        x64 = jnp.asarray(np.asarray(x), jnp.float64)
+        e_f = BasicEncoder(128, "instance", 0.0, jnp.float64)
+        e_u = BasicEncoder(128, "instance", 0.0, jnp.float64,
+                           fold_layer1=False)
+        v64 = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a), jnp.float64), v)
+        gf = jax.grad(lambda v: jnp.sum(jnp.sin(e_f.apply(v, x64))))(v64)
+        gu = jax.grad(lambda v: jnp.sum(jnp.sin(e_u.apply(v, x64))))(v64)
+        for (p, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(gf),
+                jax.tree_util.tree_leaves_with_path(gu)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-9, atol=1e-9,
+                                       err_msg=str(p))
