@@ -21,9 +21,10 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from raft_tpu.models.layers import (BottleneckBlock, FoldedResidualBlock,
-                                    Norm, ResidualBlock, conv, fold_w,
-                                    unfold_w)
+from raft_tpu.models.layers import (BottleneckBlock,
+                                    FoldedEntryResidualBlock,
+                                    FoldedResidualBlock, Norm,
+                                    ResidualBlock, conv, fold_w)
 
 
 class BasicEncoder(nn.Module):
@@ -57,8 +58,13 @@ class BasicEncoder(nn.Module):
                 x = FoldedResidualBlock(64, self.norm, dt,
                                         name=f"layer1_{i}")(
                     x, train, freeze_bn)
-            x = unfold_w(x)
-            start = 2
+            # layer2_0 (stride 2) consumes the folded layout directly —
+            # its width step lands exactly on the folded column count,
+            # so no unfold relayout is needed anywhere.
+            x = FoldedEntryResidualBlock(96, self.norm, dt,
+                                         name="layer2_0")(
+                x, train, freeze_bn)
+            start = 3
         for i, (planes, stride) in enumerate(stages[start:], start=start):
             x = ResidualBlock(planes, self.norm, stride, dt,
                               name=f"layer{i // 2 + 1}_{i % 2}")(

@@ -279,6 +279,68 @@ class _FoldedNorm(nn.Module):
         raise ValueError(f"unfoldable norm kind: {self.kind}")
 
 
+class _FoldedEntryConv(nn.Module):
+    """Original 3x3/stride-2 conv consuming the FOLDED layout: output
+    column q is original column 2q, whose three width taps live in
+    folded columns q-1 (parity 1) and q (parities 0 and 1) — a (3, 2)
+    kernel at stride (2, 1) over ``(H, Wf)``.  Param names/shapes match
+    ``conv()`` ("kernel" (3,3,C,P), "bias" (P,))."""
+
+    cin: int
+    planes: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xf):
+        C, P = self.cin, self.planes
+        kernel = self.param("kernel", kaiming_out, (3, 3, C, P),
+                            jnp.float32)
+        bias = self.param("bias", torch_bias_init(C * 9), (P,),
+                          jnp.float32)
+        kf = jnp.zeros((3, 2, 2 * C, P), kernel.dtype)
+        kf = kf.at[:, 0, C:, :].set(kernel[:, 0])   # col 2q-1 = (q-1, b1)
+        kf = kf.at[:, 1, :C, :].set(kernel[:, 1])   # col 2q   = (q,   b0)
+        kf = kf.at[:, 1, C:, :].set(kernel[:, 2])   # col 2q+1 = (q,   b1)
+        y = jax.lax.conv_general_dilated(
+            xf.astype(self.dtype), kf.astype(self.dtype), (2, 1),
+            [(1, 1), (1, 0)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y + bias.astype(self.dtype)
+
+
+class FoldedEntryResidualBlock(nn.Module):
+    """:class:`ResidualBlock` with ``stride=2`` whose INPUT arrives in
+    folded-width layout and whose output is standard: the stride-2 width
+    step lands exactly on the folded column count, so consuming the fold
+    directly removes the unfold relayout and halves the entry conv's
+    input reads.  Identical math and parameter tree to the unfolded
+    stride-2 block (the 1x1 downsample reads original even columns =
+    folded parity-0 channels, even rows via slicing)."""
+
+    planes: int
+    norm: str
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xf, train: bool = False, freeze_bn: bool = False):
+        C = xf.shape[-1] // 2
+        y = _FoldedEntryConv(C, self.planes, self.dtype, name="conv1")(xf)
+        y = Norm(self.norm, self.planes, dtype=self.dtype, name="norm1")(
+            y, train, freeze_bn)
+        y = nn.relu(y)
+        y = conv(self.planes, 3, 1, self.dtype, name="conv2",
+                 in_features=self.planes)(y)
+        y = Norm(self.norm, self.planes, dtype=self.dtype, name="norm2")(
+            y, train, freeze_bn)
+        y = nn.relu(y)
+
+        x_even = xf[:, ::2, :, :C]            # orig even rows, even cols
+        x = conv(self.planes, 1, 1, self.dtype, name="downsample_conv",
+                 in_features=C)(x_even)
+        x = Norm(self.norm, self.planes, dtype=self.dtype, name="norm3")(
+            x, train, freeze_bn)
+        return nn.relu(x + y)
+
+
 class FoldedResidualBlock(nn.Module):
     """:class:`ResidualBlock` (stride 1) computed entirely in folded-width
     layout — identical math and parameter tree, lane-dense tiles."""
