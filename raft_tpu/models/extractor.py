@@ -24,7 +24,8 @@ import jax.numpy as jnp
 from raft_tpu.models.layers import (BottleneckBlock,
                                     FoldedEntryResidualBlock,
                                     FoldedResidualBlock, Norm,
-                                    ResidualBlock, conv, fold_w)
+                                    ResidualBlock, _FoldedNorm,
+                                    _FoldedStemConv, conv)
 
 
 class BasicEncoder(nn.Module):
@@ -42,18 +43,19 @@ class BasicEncoder(nn.Module):
     def __call__(self, x, train: bool = False, freeze_bn: bool = False):
         dt = self.dtype
         x = x.astype(dt)
-        x = conv(64, 7, 2, dt, name="conv1", in_features=3)(x)
-        # stem GroupNorm uses 8 groups, not 64//8 (reference extractor.py:124)
-        x = Norm(self.norm, 64, num_groups=8, dtype=dt, name="norm1")(
-            x, train, freeze_bn)
-        x = nn.relu(x)
-
         stages = [(64, 1), (64, 1), (96, 2), (96, 1), (128, 2), (128, 1)]
-        folded = (self.fold_layer1 and x.shape[2] % 2 == 0
+        # Stem output width is ceil(W/2) for even W (pad 3, k=7, s=2);
+        # folding needs it even, i.e. W % 4 == 0 (InputPadder-padded
+        # inputs always are).
+        folded = (self.fold_layer1 and x.shape[2] % 4 == 0
                   and self.norm in ("instance", "batch", "none"))
         start = 0
         if folded:
-            x = fold_w(x)
+            # Stem emits the folded layout directly — no relayout pass.
+            x = _FoldedStemConv(3, 64, dt, name="conv1")(x)
+            x = _FoldedNorm(self.norm, 64, dt, name="norm1")(
+                x, train, freeze_bn)
+            x = nn.relu(x)
             for i in range(2):
                 x = FoldedResidualBlock(64, self.norm, dt,
                                         name=f"layer1_{i}")(
@@ -65,6 +67,13 @@ class BasicEncoder(nn.Module):
                                          name="layer2_0")(
                 x, train, freeze_bn)
             start = 3
+        else:
+            x = conv(64, 7, 2, dt, name="conv1", in_features=3)(x)
+            # stem GroupNorm uses 8 groups, not 64//8 (reference
+            # extractor.py:124)
+            x = Norm(self.norm, 64, num_groups=8, dtype=dt,
+                     name="norm1")(x, train, freeze_bn)
+            x = nn.relu(x)
         for i, (planes, stride) in enumerate(stages[start:], start=start):
             x = ResidualBlock(planes, self.norm, stride, dt,
                               name=f"layer{i // 2 + 1}_{i % 2}")(

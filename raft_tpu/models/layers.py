@@ -279,6 +279,35 @@ class _FoldedNorm(nn.Module):
         raise ValueError(f"unfoldable norm kind: {self.kind}")
 
 
+class _FoldedStemConv(nn.Module):
+    """Original 7x7/stride-2 stem conv emitting the FOLDED layout
+    directly: folded output column p holds original columns 2p (parity
+    0, input center 4p, window 4p-3..4p+3) and 2p+1 (parity 1, center
+    4p+2, window 4p-1..4p+5) — one (7, 9) kernel at stride (2, 4) whose
+    width taps embed the original (7, 7) kernel at offsets 0 and 2.
+    Param names/shapes match the unfolded stem ("kernel" (7,7,cin,P),
+    "bias" (P,)), and the fold relayout after the stem disappears."""
+
+    cin: int
+    planes: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        C, P = self.cin, self.planes
+        kernel = self.param("kernel", kaiming_out, (7, 7, C, P),
+                            jnp.float32)
+        bias = self.param("bias", torch_bias_init(C * 49), (P,),
+                          jnp.float32)
+        kf = jnp.zeros((7, 9, C, 2 * P), kernel.dtype)
+        kf = kf.at[:, 0:7, :, :P].set(kernel)
+        kf = kf.at[:, 2:9, :, P:].set(kernel)
+        y = jax.lax.conv_general_dilated(
+            x.astype(self.dtype), kf.astype(self.dtype), (2, 4),
+            [(3, 3), (3, 2)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y + jnp.tile(bias, 2).astype(self.dtype)
+
+
 class _FoldedEntryConv(nn.Module):
     """Original 3x3/stride-2 conv consuming the FOLDED layout: output
     column q is original column 2q, whose three width taps live in

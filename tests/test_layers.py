@@ -132,3 +132,18 @@ def test_encoder_folded_matches_unfolded_and_gradients():
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-9, atol=1e-9,
                                        err_msg=str(p))
+
+
+def test_encoder_fold_fallback_odd_width():
+    """Widths that break the fold contract (W % 4 != 0) must fall back
+    to the unfolded path and still agree with fold_layer1=False."""
+    from raft_tpu.models.extractor import BasicEncoder
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.uniform(-1, 1, (1, 24, 34, 3)), jnp.float32)
+    enc_f = BasicEncoder(64, "instance", 0.0)
+    enc_u = BasicEncoder(64, "instance", 0.0, fold_layer1=False)
+    v = enc_f.init(jax.random.PRNGKey(0), x, False, False)
+    np.testing.assert_allclose(np.asarray(enc_f.apply(v, x)),
+                               np.asarray(enc_u.apply(v, x)),
+                               rtol=1e-6, atol=1e-6)
