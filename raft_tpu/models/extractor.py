@@ -24,8 +24,8 @@ import jax.numpy as jnp
 from raft_tpu.models.layers import (BottleneckBlock,
                                     FoldedEntryResidualBlock,
                                     FoldedResidualBlock, Norm,
-                                    ResidualBlock, _FoldedNorm,
-                                    _FoldedStemConv, conv)
+                                    ResidualBlock, FoldedNorm,
+                                    FoldedStemConv, conv)
 
 
 class BasicEncoder(nn.Module):
@@ -52,8 +52,8 @@ class BasicEncoder(nn.Module):
         start = 0
         if folded:
             # Stem emits the folded layout directly — no relayout pass.
-            x = _FoldedStemConv(3, 64, dt, name="conv1")(x)
-            x = _FoldedNorm(self.norm, 64, dt, name="norm1")(
+            x = FoldedStemConv(3, 64, dt, name="conv1")(x)
+            x = FoldedNorm(self.norm, 64, dt, name="norm1")(
                 x, train, freeze_bn)
             x = nn.relu(x)
             for i in range(2):

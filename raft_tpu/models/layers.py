@@ -250,7 +250,7 @@ class _FoldedBatchNorm(nn.Module):
         return y.astype(self.dtype)
 
 
-class _FoldedNorm(nn.Module):
+class FoldedNorm(nn.Module):
     """Folded-layout dispatch over the norm modes a folded block
     supports (instance / batch / none; 'group' falls back to the
     unfolded path at the encoder level)."""
@@ -279,7 +279,7 @@ class _FoldedNorm(nn.Module):
         raise ValueError(f"unfoldable norm kind: {self.kind}")
 
 
-class _FoldedStemConv(nn.Module):
+class FoldedStemConv(nn.Module):
     """Original 7x7/stride-2 stem conv emitting the FOLDED layout
     directly: folded output column p holds original columns 2p (parity
     0, input center 4p, window 4p-3..4p+3) and 2p+1 (parity 1, center
@@ -381,11 +381,11 @@ class FoldedResidualBlock(nn.Module):
     @nn.compact
     def __call__(self, xf, train: bool = False, freeze_bn: bool = False):
         y = _FoldedConv3x3(self.planes, self.dtype, name="conv1")(xf)
-        y = _FoldedNorm(self.norm, self.planes, self.dtype,
+        y = FoldedNorm(self.norm, self.planes, self.dtype,
                         name="norm1")(y, train, freeze_bn)
         y = nn.relu(y)
         y = _FoldedConv3x3(self.planes, self.dtype, name="conv2")(y)
-        y = _FoldedNorm(self.norm, self.planes, self.dtype,
+        y = FoldedNorm(self.norm, self.planes, self.dtype,
                         name="norm2")(y, train, freeze_bn)
         y = nn.relu(y)
         return nn.relu(xf + y)
