@@ -87,9 +87,13 @@ class RefinementStep(nn.Module):
         elif cfg.corr_impl == "allpairs_pallas":
             from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup
 
+            # Taps are consumed in cfg.dtype (the astype below) — emit
+            # them in that dtype from the kernel and skip the fp32
+            # round-trip through HBM (np.dtype is hashable, so it works
+            # as a custom_vjp static arg).
             corr = pallas_pyramid_lookup(corr_state, coords1,
                                          cfg.corr_radius,
-                                         cfg.lookup_block_q)
+                                         cfg.lookup_block_q, None, dt)
         elif cfg.corr_impl == "pallas":
             from raft_tpu.ops.pallas_corr import pallas_corr_lookup
 

@@ -346,7 +346,8 @@ def _pyr_fwd_level_body(corr_ref, c_ref, out_ref, lvl, out_off, hl, wl, k):
     for i in range(k):
         for j in range(k):
             out_ref[0, out_off + i * k + j:out_off + i * k + j + 1, :] = \
-                jnp.sum(wx[i] * accs[j], axis=0, keepdims=True)
+                jnp.sum(wx[i] * accs[j], axis=0,
+                        keepdims=True).astype(out_ref.dtype)
 
 
 def _pyr_bwd_level_body(c_ref, g_ref, dcorr_ref, lvl, g_off, hl, wl, k):
@@ -401,7 +402,7 @@ def _pyr_multi_fwd_kernel(*refs, levels, k, kk_total):
         covered += k * k
     if covered < kk_total:  # empty (over-pooled) trailing levels -> zeros
         out_ref[0, covered:, :] = jnp.zeros((kk_total - covered, bq),
-                                            jnp.float32)
+                                            out_ref.dtype)
 
 
 def _pyr_multi_bwd_kernel(*refs, levels, k):
@@ -412,7 +413,8 @@ def _pyr_multi_bwd_kernel(*refs, levels, k):
         _pyr_bwd_level_body(c_ref, g_ref, dcorr_ref, lvl, off, hl, wl, k)
 
 
-def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret):
+def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret,
+                    out_dtype=jnp.float32):
     """All levels in ONE pallas_call -> (B, L*k*k, Npad) taps.
 
     Query-minor layout throughout: ``pyramid`` levels are
@@ -440,7 +442,7 @@ def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret):
         out_specs=pl.BlockSpec((1, L * k * k, block_q),
                                lambda b, i: (b, 0, i),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, L * k * k, Npad), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((B, L * k * k, Npad), out_dtype),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
@@ -500,9 +502,10 @@ def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
             for lvl, (s, dt) in enumerate(shapes)]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
 def pallas_pyramid_lookup(pyramid, coords, radius: int = 4,
-                          block_q: int = 128, interpret=None):
+                          block_q: int = 128, interpret=None,
+                          out_dtype=jnp.float32):
     """Fused window sampling of a MATERIALIZED correlation pyramid.
 
     Drop-in replacement for :func:`raft_tpu.ops.corr.corr_lookup` (the
@@ -519,15 +522,22 @@ def pallas_pyramid_lookup(pyramid, coords, radius: int = 4,
         fmap1 rows correlate to zero).
       coords: ``(B, H1, W1, 2)`` level-0 centroids (N = H1*W1 real
         queries), last axis ``(x, y)``.
+      out_dtype: tap output dtype.  Pass bf16 when the consumer casts
+        immediately anyway (the refinement step does) — halves the tap
+        write/read traffic; accumulation stays fp32 in-kernel either
+        way (hashable static arg: use ``jnp.bfloat16``, not a dtype
+        instance).
 
     Returns:
-      ``(B, H1, W1, L * (2r+1)^2)`` fp32 lookup features.
+      ``(B, H1, W1, L * (2r+1)^2)`` ``out_dtype`` lookup features.
     """
-    out, _ = _pyr_fwd(pyramid, coords, radius, block_q, interpret)
+    out, _ = _pyr_fwd(pyramid, coords, radius, block_q, interpret,
+                      out_dtype)
     return out
 
 
-def _pyr_fwd(pyramid, coords, radius, block_q, interpret):
+def _pyr_fwd(pyramid, coords, radius, block_q, interpret,
+             out_dtype=jnp.float32):
     if interpret is None:
         interpret = _auto_interpret()
     B, H1, W1, _ = coords.shape
@@ -542,7 +552,8 @@ def _pyr_fwd(pyramid, coords, radius, block_q, interpret):
     k = 2 * radius + 1
     c = _pad_coords_oor(coords.reshape(B, N, 2).astype(jnp.float32),
                         Npad).transpose(0, 2, 1)
-    out = _pyr_levels_fwd(list(pyramid), c, radius, block_q, interpret)
+    out = _pyr_levels_fwd(list(pyramid), c, radius, block_q, interpret,
+                          out_dtype)
     out = out[:, :, :N].reshape(B, len(pyramid) * k * k, H1, W1)
     # The bwd needs each level's shape AND stored dtype (cotangents must
     # match the primal dtypes, which may differ per level); dtypes aren't
@@ -552,7 +563,7 @@ def _pyr_fwd(pyramid, coords, radius, block_q, interpret):
              tuple(jnp.zeros((0,), x.dtype) for x in pyramid), coords))
 
 
-def _pyr_bwd(radius, block_q, interpret, residuals, g):
+def _pyr_bwd(radius, block_q, interpret, out_dtype, residuals, g):
     shapes, protos, coords = residuals
     if interpret is None:
         interpret = _auto_interpret()
