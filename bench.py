@@ -176,6 +176,15 @@ def main():
         "value": round(pairs_per_sec_per_chip, 3),
         "unit": "image-pairs/sec/chip",
         "vs_baseline": round(vs, 3),
+        # Bench-config knobs that differ from the MODEL defaults (bench
+        # defaults remat=0/remat_upsample=0, which won at this shape;
+        # the model ships save_corr/remat_upsample=1 — safe for big
+        # crops).  Recorded so BENCH_*.json A/Bs across rounds always
+        # say what configuration they measured.
+        "config": {"batch_per_chip": per_chip_batch, "corr_impl": corr_impl,
+                   "remat": remat,
+                   "remat_upsample": model_cfg.remat_upsample,
+                   "scan_unroll": scan_unroll},
     }))
 
 

@@ -56,6 +56,9 @@ def parse_args(argv=None):
                    help="exponential loss weighting")
     p.add_argument("--add_noise", action="store_true")
     p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--val_freq", type=int, default=5000,
+                   help="checkpoint + validation cadence in steps "
+                        "(reference VAL_FREQ, train.py:159)")
     p.add_argument("--remat", default="save_corr",
                    choices=["save_corr", "full", "dots", "none"],
                    help="backward rematerialization of the refinement "
@@ -183,6 +186,7 @@ def main(argv=None):
         image_size=tuple(args.image_size), iters=args.iters,
         wdecay=args.wdecay, epsilon=args.epsilon, clip=args.clip,
         gamma=args.gamma, add_noise=args.add_noise, seed=args.seed,
+        val_freq=args.val_freq,
         freeze_bn=args.stage != "chairs",  # reference train.py:147-148
         ckpt_dir=args.ckpt_dir)
     dataset = fetch_dataset(args.stage, tuple(args.image_size),
@@ -237,6 +241,25 @@ def main(argv=None):
 
         mesh = make_mesh(num_data=num_devices // args.shard_spatial,
                          num_spatial=args.shard_spatial)
+
+    # Pod preemption (SIGTERM) -> cooperative flag -> the train loop
+    # exits at the next STEP BOUNDARY with an emergency checkpoint of
+    # the last completed step (train/loop.py), so a preempted run
+    # resumes with optimizer/LR state and mid-epoch shuffle position
+    # intact.  (A flag, not an async exception: an exception could land
+    # mid-orbax-save and abort a registered-but-uncommitted step.)
+    # Single-host only — multi-host preemption goes through JAX's
+    # coordination-service sync protocol (SIGTERM is its default
+    # notice), polled by the loop, so all hosts exit at the SAME agreed
+    # step; a python handler here would shadow it.
+    if jax.process_count() == 1:
+        import signal
+
+        from raft_tpu.train.loop import request_preemption
+
+        signal.signal(signal.SIGTERM,
+                      lambda signum, frame: request_preemption())
+
     train(model_cfg, cfg, loader=loader, validators=validators or None,
           restore_params=restore, tensorboard_dir=args.tensorboard_dir,
           profile_dir=args.profile_dir, mesh=mesh,

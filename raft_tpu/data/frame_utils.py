@@ -75,6 +75,25 @@ def read_pfm(path: str) -> np.ndarray:
     return np.flipud(data.reshape(shape)).astype(np.float32)
 
 
+def write_pfm(path: str, data: np.ndarray) -> None:
+    """Write a ``.pfm``: (H, W) -> ``Pf``, (H, W, 3) -> ``PF``; top row
+    first in memory, stored bottom-up little-endian (scale -1.0) — the
+    exact inverse of :func:`read_pfm`.  (The reference only reads PFM;
+    the writer exists for synthetic-corpus fixtures.)"""
+    data = np.asarray(data, np.float32)
+    if data.ndim == 2:
+        header = b"Pf"
+    elif data.ndim == 3 and data.shape[2] == 3:
+        header = b"PF"
+    else:
+        raise ValueError(f"PFM needs (H,W) or (H,W,3), got {data.shape}")
+    with open(path, "wb") as f:
+        f.write(header + b"\n")
+        f.write(f"{data.shape[1]} {data.shape[0]}\n".encode())
+        f.write(b"-1.0\n")
+        np.flipud(data).astype("<f4").tofile(f)
+
+
 def read_flow_kitti(path: str) -> Tuple[np.ndarray, np.ndarray]:
     """KITTI flow PNG: ``flow = (png_uint16 - 2^15) / 64``; the 3rd channel
     is the validity mask (reference ``readFlowKITTI``,

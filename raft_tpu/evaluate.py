@@ -99,6 +99,9 @@ def _peek_hw(path: str):
     return h, w
 
 
+_BUCKET_CACHE: Dict[tuple, tuple] = {}
+
+
 def _bucket_hw(ds) -> tuple:
     """One /8-aligned bucket shape covering every image in the dataset.
 
@@ -108,10 +111,24 @@ def _bucket_hw(ds) -> tuple:
     compiles ONCE; edge-replicate padding repeats the border row, so
     content inside the original frame sees the same receptive fields (the
     residual effect is the instance-norm statistics over the slightly
-    larger canvas — sub-0.01 EPE, and the reference pays the same class of
-    artifact in its own right-padding, core/utils/utils.py:7-24)."""
-    hs, ws = zip(*(_peek_hw(p1) for (p1, _) in ds.image_list))
-    return (-(-max(hs) // 8) * 8, -(-max(ws) // 8) * 8)
+    larger canvas, which the reference also pays in its own right-padding,
+    core/utils/utils.py:7-24).  The bucket-vs-exact residual is bounded at
+    rel=0.15 on random-init weights (tests/test_evaluate.py); pinning it
+    tighter (expected well under 0.01 EPE on a trained model, whose
+    features are far from the decision boundaries random init sits on)
+    needs real weights — weights-blocked, see
+    docs/REAL_WEIGHTS_RUNBOOK.md.
+
+    Header peeks are cached per image-path set: validators construct a
+    fresh dataset every call (val_freq cadence), and re-opening every
+    header ~20x per stage was pure waste."""
+    key = tuple(p1 for (p1, _) in ds.image_list)
+    hit = _BUCKET_CACHE.get(key)
+    if hit is None:
+        hs, ws = zip(*(_peek_hw(p) for p in key))
+        hit = _BUCKET_CACHE[key] = (-(-max(hs) // 8) * 8,
+                                    -(-max(ws) // 8) * 8)
+    return hit
 
 
 def _batched_flows(variables, eval_fn, ds, mode: str, batch_size: int,
@@ -226,51 +243,108 @@ def create_sintel_submission(variables,
                              iters: int = 32, warm_start: bool = False,
                              root: str = "datasets/Sintel",
                              output_path: str = "sintel_submission",
-                             eval_fn=None) -> None:
+                             eval_fn=None, batch_size: int = 4) -> None:
     """Write test-split ``.flo`` predictions (reference evaluate.py:22-51).
 
     ``warm_start``: seed each frame with the previous frame's 1/8-res flow
     forward-warped along itself (evaluate.py:40-41) — the scattered-data
     interpolation runs on host.
-    """
+
+    Batching: warm start chains frames *within* a sequence, but distinct
+    sequences are independent — so each batch lane carries one SEQUENCE
+    and time steps across lanes share one compiled forward (the
+    reference streams batch-1 frames, evaluate.py:30).  A zero
+    ``flow_init`` is identical to no warm start (coords1 += 0), which
+    lets lane restarts and non-warm-start lanes share the jit entry.
+    Finished lanes repeat their last frame; outputs for those are
+    discarded."""
     eval_fn = eval_fn or make_eval_fn(model_cfg, iters)
     for dstype in ("clean", "final"):
         ds = datasets.MpiSintel(split="test", aug_params=None,
                                 dstype=dstype, root=root)
-        flow_prev, sequence_prev = None, None
-        for i in range(len(ds)):
-            sample = ds.load(i)
-            sequence, frame = sample["extra_info"]
-            if sequence != sequence_prev:
-                flow_prev = None
-            image1, image2, padder = _prep(sample, "sintel")
-            flow_low, flow_up = eval_fn(variables, image1, image2, flow_prev)
-            flow = np.asarray(padder.unpad(flow_up)[0])
-            if warm_start:
-                flow_prev = jnp.asarray(
-                    forward_interpolate(np.asarray(flow_low[0])))[None]
-            out_dir = osp.join(output_path, dstype, sequence)
-            os.makedirs(out_dir, exist_ok=True)
-            frame_utils.write_flo(
-                osp.join(out_dir, f"frame{frame + 1:04d}.flo"), flow)
-            sequence_prev = sequence
+        seq_frames: Dict[str, list] = {}
+        for i, (scene, frame) in enumerate(ds.extra_info):
+            seq_frames.setdefault(scene, []).append((frame, i))
+        lanes_all = [[i for _, i in sorted(v)] for v in seq_frames.values()]
+        if not lanes_all:
+            continue  # empty split: a graceful no-op, like the old loop
+        B = min(batch_size, len(lanes_all))
+        for g0 in range(0, len(lanes_all), B):
+            real = lanes_all[g0:g0 + B]
+            # Padding lanes keep the compiled batch shape but are pure
+            # ballast: length 0, so they never decode, never
+            # forward-interpolate, never write — they just replicate the
+            # last real lane's pixels below.
+            lanes = real + [[]] * (B - len(real))
+            flow_prev = None
+            cache = [None] * B  # (sample, padder) of a finished lane
+            for t in range(max(len(ln) for ln in real)):
+                samples, padders = [], []
+                for j, ln in enumerate(lanes):
+                    if t < len(ln):
+                        s = ds.load(ln[t])
+                        p = InputPadder(s["image1"].shape, mode="sintel")
+                        cache[j] = (s, p)
+                    elif cache[j] is not None:
+                        s, p = cache[j]  # finished lane: no re-decode
+                    else:
+                        s, p = samples[len(real) - 1], padders[
+                            len(real) - 1]  # padding lane: mirror
+                    samples.append(s)
+                    padders.append(p)
+                im1 = np.stack([p.pad_np(s["image1"])
+                                for p, s in zip(padders, samples)])
+                im2 = np.stack([p.pad_np(s["image2"])
+                                for p, s in zip(padders, samples)])
+                flow_low, flow_up = eval_fn(variables, jnp.asarray(im1),
+                                            jnp.asarray(im2), flow_prev)
+                flow_up = np.asarray(flow_up)
+                if warm_start:
+                    # Per-lane host forward-warp, only for lanes still
+                    # active NEXT step (griddata is the slowest host op
+                    # here; finished/padding lanes keep a zero init —
+                    # their dummy outputs are never written).
+                    low = np.asarray(flow_low)
+                    flow_prev = jnp.asarray(np.stack([
+                        forward_interpolate(low[j])
+                        if t + 1 < len(lanes[j]) else
+                        np.zeros_like(low[j])
+                        for j in range(B)]))
+                for j, (ln, s, p) in enumerate(zip(lanes, samples,
+                                                   padders)):
+                    if t >= len(ln):
+                        continue  # finished/padding lane
+                    scene, frame = s["extra_info"]
+                    out_dir = osp.join(output_path, dstype, scene)
+                    os.makedirs(out_dir, exist_ok=True)
+                    frame_utils.write_flo(
+                        osp.join(out_dir, f"frame{frame + 1:04d}.flo"),
+                        np.asarray(p.unpad(flow_up[j:j + 1])[0]))
 
 
 def create_kitti_submission(variables,
                             model_cfg: RAFTConfig = RAFTConfig.full(),
                             iters: int = 24, root: str = "datasets/KITTI",
                             output_path: str = "kitti_submission",
-                            eval_fn=None) -> None:
-    """Write test-split 16-bit PNG flow (reference evaluate.py:54-72)."""
+                            eval_fn=None, batch_size: int = 4,
+                            bucket: bool = True) -> None:
+    """Write test-split 16-bit PNG flow (reference evaluate.py:54-72),
+    streamed through the bucketed fixed-shape batch path (one compile
+    for the whole split, like the validators).
+
+    ``bucket=False`` restores the reference's exact minimal per-image
+    padding (batch 1, one compile per native resolution) — this is the
+    artifact actually uploaded to the leaderboard, and the bucket
+    residual (instance-norm statistics over the padded canvas) is only
+    bounded at rel=0.15 on random-init weights until real weights land
+    (see :func:`_bucket_hw`)."""
     eval_fn = eval_fn or make_eval_fn(model_cfg, iters)
     ds = datasets.KITTI(split="testing", aug_params=None, root=root)
     os.makedirs(output_path, exist_ok=True)
-    for i in range(len(ds)):
-        sample = ds.load(i)
+    target, bs = (_bucket_hw(ds), batch_size) if bucket else (None, 1)
+    for sample, flow in _batched_flows(variables, eval_fn, ds, "kitti",
+                                       bs, target=target):
         (frame_id,) = sample["extra_info"]
-        image1, image2, padder = _prep(sample, "kitti")
-        _, flow_up = eval_fn(variables, image1, image2)
-        flow = np.asarray(padder.unpad(flow_up)[0])
         frame_utils.write_flow_kitti(osp.join(output_path, frame_id), flow)
 
 

@@ -183,7 +183,12 @@ def _fold_kernel_3x3(w: jax.Array) -> jax.Array:
 class _FoldedConv3x3(nn.Module):
     """3x3/stride-1 conv applied in folded-width layout.  Parameter names
     and shapes ("kernel" (3,3,C,C), "bias" (C,)) are identical to the
-    ``conv()`` path, so the param tree is checkpoint-compatible."""
+    ``conv()`` path, so the param tree is checkpoint-compatible.
+
+    Parity with ``conv()`` is exact only at fp32+: the kernel fold runs
+    in fp32 before the cast to self.dtype, while nn.Conv casts params
+    first — a bf16-ULP-level difference under bf16 compute (bounded by
+    tests/test_layers.py::test_encoder_folded_matches_unfolded_bf16)."""
 
     channels: int
     dtype: Any = jnp.float32
@@ -219,7 +224,13 @@ def _pair_stats(x: jax.Array, axes, C: int):
 class _FoldedBatchNorm(nn.Module):
     """flax BatchNorm semantics (momentum 0.9, eps 1e-5, biased var,
     fp32 stats) on the folded layout; param/variable names match
-    ``nn.BatchNorm`` for checkpoint compatibility."""
+    ``nn.BatchNorm`` for checkpoint compatibility.
+
+    Parity with the unfolded path is exact only at fp32+: this module
+    normalizes in fp32 and rounds once at the end, while nn.BatchNorm
+    performs the (x-mean)*inv*scale+bias arithmetic in self.dtype — so
+    under bf16 compute the two differ at bf16-ULP level (bounded by
+    tests/test_layers.py::test_encoder_folded_matches_unfolded_bf16)."""
 
     channels: int
     dtype: Any = jnp.float32

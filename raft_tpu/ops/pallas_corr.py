@@ -464,8 +464,11 @@ def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
     k = 2 * radius + 1
     nonempty = [(lvl, s, dt) for lvl, (s, dt) in enumerate(shapes)
                 if s[1] and s[2]]
-    # [[level0], [level1..]] — singleton groups when only one level.
-    groups = [nonempty[:1]] + ([nonempty[1:]] if nonempty[1:] else [])
+    # [[level0], [level1..]] — singleton groups when only one level;
+    # no groups at all when every level is empty (degenerate over-pooled
+    # pyramid) so the all-zeros fallback below covers it instead of a
+    # zero-output pallas_call.
+    groups = [g for g in (nonempty[:1], nonempty[1:]) if g]
     by_level = {}
     for grp in groups:
         kern = functools.partial(

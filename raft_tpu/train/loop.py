@@ -30,6 +30,40 @@ from raft_tpu.train.state import TrainState
 from raft_tpu.train.step import init_state, make_train_step
 from raft_tpu.utils.profiling import StepProfiler, annotate_step
 
+# Cooperative preemption: a SIGTERM handler (cli/train.py) sets this and
+# the loop exits at the NEXT STEP BOUNDARY — an async exception could
+# land mid-`mgr.save` and abort a registered-but-uncommitted orbax step,
+# which the emergency path below would then mistake for a completed save.
+import threading
+
+_PREEMPT = threading.Event()
+
+
+def request_preemption() -> None:
+    """Ask the running train() loop to checkpoint and exit after the
+    current step completes (safe to call from a signal handler).
+
+    Single-host only (the CLI wires SIGTERM here when
+    ``process_count() == 1``): a per-host flag has no cross-host
+    agreement, so hosts could exit at different step boundaries and
+    deadlock the gradient psum / orbax barrier.  Multi-host preemption
+    instead rides JAX's coordination-service sync protocol — SIGTERM is
+    its default preemption notice, and ``train()`` polls
+    ``reached_preemption_sync_point(step)`` every step, which returns
+    True on ALL hosts at the same agreed safe step."""
+    _PREEMPT.set()
+
+
+def _reached_preemption_sync(step: int) -> bool:
+    """Multi-host agreed preemption step (False when the preemption
+    service is unavailable)."""
+    from jax.experimental import multihost_utils
+
+    try:
+        return multihost_utils.reached_preemption_sync_point(step)
+    except RuntimeError:  # jax_enable_preemption_service disabled
+        return False
+
 
 def add_image_noise(rng: np.random.Generator, batch: Dict) -> Dict:
     """Gaussian noise with stdv ~ U(0, 5), clipped to [0, 255]
@@ -68,6 +102,7 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
     """
     assert (batches is None) != (loader is None), \
         "pass exactly one of batches= or loader="
+    _PREEMPT.clear()  # a new run starts unpreempted
     mesh = mesh or make_mesh()
     model = RAFT(model_cfg)
     tx = make_optimizer(cfg.lr, cfg.num_steps, cfg.wdecay, cfg.epsilon,
@@ -103,39 +138,62 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
         np.random.SeedSequence([cfg.seed + 1, step]))
     profiler = StepProfiler(profile_dir)
     t0, steps_t0 = time.time(), step
-    for batch in batches:
-        if step >= cfg.num_steps:
-            break
-        if cfg.add_noise:
-            batch = add_image_noise(noise_rng, batch)
-        profiler.maybe_start(step)
-        with annotate_step(step):
-            state, metrics = step_fn(
-                state, shard_batch(batch, mesh, spatial=shard_spatial), key)
-        profiler.maybe_stop(step, sync_on=metrics.get("loss"))
-        step += 1
-        logger.push(step - 1, metrics)
+    try:
+        for batch in batches:
+            if step >= cfg.num_steps:
+                break
+            if _PREEMPT.is_set() or (
+                    jax.process_count() > 1
+                    and _reached_preemption_sync(step)):
+                raise SystemExit(143)  # step boundary; state is consistent
+            if cfg.add_noise:
+                batch = add_image_noise(noise_rng, batch)
+            profiler.maybe_start(step)
+            with annotate_step(step):
+                state, metrics = step_fn(
+                    state, shard_batch(batch, mesh, spatial=shard_spatial),
+                    key)
+            profiler.maybe_stop(step, sync_on=metrics.get("loss"))
+            step += 1
+            logger.push(step - 1, metrics)
 
-        if step % cfg.val_freq == 0:
-            mgr.save(step, state)
-            if validators:
-                variables = {"params": state.params}
-                if state.batch_stats:
-                    variables["batch_stats"] = state.batch_stats
-                results = {}
-                for name, fn in validators.items():
-                    results.update(fn(variables))
-                logger.write_dict(step, results)
-            dt = time.time() - t0
-            ips = (step - steps_t0) * cfg.batch_size / max(dt, 1e-9)
-            print(f"throughput: {ips:.2f} image-pairs/sec (host)",
-                  flush=True)
-            t0, steps_t0 = time.time(), step
+            if step % cfg.val_freq == 0:
+                mgr.save(step, state)
+                if validators:
+                    variables = {"params": state.params}
+                    if state.batch_stats:
+                        variables["batch_stats"] = state.batch_stats
+                    results = {}
+                    for name, fn in validators.items():
+                        results.update(fn(variables))
+                    logger.write_dict(step, results)
+                dt = time.time() - t0
+                ips = (step - steps_t0) * cfg.batch_size / max(dt, 1e-9)
+                print(f"throughput: {ips:.2f} image-pairs/sec (host)",
+                      flush=True)
+                t0, steps_t0 = time.time(), step
 
-    if mgr.latest_step() != int(state.step):
-        mgr.save(int(state.step), state, force=True)
-    mgr.wait()
-    mgr.close()
-    profiler.close()
-    logger.close()
+        if mgr.latest_step() != int(state.step):
+            mgr.save(int(state.step), state, force=True)
+    except (KeyboardInterrupt, SystemExit):
+        # Preemption: flush the last COMPLETED step so auto-resume
+        # continues exactly where the pod died — optimizer/LR state and
+        # the loader's mid-epoch shuffle position included.  The
+        # reference loses all three (its every-5000-step weights-only
+        # torch.save, train.py:185-187,141-142).  SIGTERM arrives via
+        # the cooperative _PREEMPT flag (raised only at the step-
+        # boundary check above), so ``state`` is a consistent snapshot;
+        # an interactive Ctrl-C can still land mid-save, in which case
+        # the force-save below may be skipped if orbax already
+        # registered the step — acceptable for the interactive case.
+        print(f"preempted at step {int(state.step)}; checkpointing",
+              flush=True)
+        if mgr.latest_step() != int(state.step):
+            mgr.save(int(state.step), state, force=True)
+        raise
+    finally:
+        mgr.wait()
+        mgr.close()
+        profiler.close()
+        logger.close()
     return state

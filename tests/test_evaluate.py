@@ -155,6 +155,42 @@ def test_sintel_submission_warm_start(variables, sintel_root, tmp_path):
             assert np.isfinite(flow).all()
 
 
+def test_sintel_submission_batched_matches_sequential(variables, tmp_path):
+    """Ragged multi-sequence warm start: two scenes of different lengths
+    ride independent batch lanes; lane-batched output must match the
+    reference-shaped sequential (batch 1) pass per frame."""
+    rng = np.random.default_rng(7)
+    root = tmp_path / "Sintel"
+    lens = {"alley_1": 4, "bandage_2": 2}  # frame PAIRS per scene
+    for scene, n in lens.items():
+        d = root / "test" / "clean" / scene
+        d.mkdir(parents=True)
+        (root / "test" / "final" / scene).mkdir(parents=True)
+        for i in range(n + 1):
+            _write_img(d / f"frame_{i:04d}.png", rng)
+            _write_img(root / "test" / "final" / scene /
+                       f"frame_{i:04d}.png", rng)
+
+    out_b = str(tmp_path / "batched")
+    out_s = str(tmp_path / "seq")
+    evaluate.create_sintel_submission(variables, CFG, iters=2,
+                                      warm_start=True, root=str(root),
+                                      output_path=out_b, batch_size=2)
+    evaluate.create_sintel_submission(variables, CFG, iters=2,
+                                      warm_start=True, root=str(root),
+                                      output_path=out_s, batch_size=1)
+    for dstype in ("clean", "final"):
+        for scene, n in lens.items():
+            for frame in range(1, n + 1):
+                rel = osp.join(dstype, scene, f"frame{frame:04d}.flo")
+                fb = frame_utils.read_flo(osp.join(out_b, rel))
+                fs = frame_utils.read_flo(osp.join(out_s, rel))
+                assert np.isfinite(fb).all()
+                # same math at different batch sizes -> different XLA
+                # programs; agreement is numeric, not bitwise
+                np.testing.assert_allclose(fb, fs, rtol=1e-4, atol=1e-4)
+
+
 def test_kitti_submission(variables, kitti_root, tmp_path):
     out = str(tmp_path / "ksub")
     evaluate.create_kitti_submission(variables, CFG, iters=2,

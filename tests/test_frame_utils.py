@@ -90,6 +90,15 @@ def test_pfm_roundtrip_both_endian(tmp_path):
         np.testing.assert_allclose(fu.read_pfm(str(p)), data, rtol=1e-6)
 
 
+def test_write_pfm_roundtrip(tmp_path):
+    rng = np.random.RandomState(5)
+    for shape in [(6, 5, 3), (6, 5)]:
+        data = rng.rand(*shape).astype(np.float32)
+        p = str(tmp_path / f"w{len(shape)}.pfm")
+        fu.write_pfm(p, data)
+        np.testing.assert_allclose(fu.read_pfm(p), data, rtol=1e-6)
+
+
 def test_read_gen_dispatch(tmp_path):
     flow = np.zeros((4, 4, 2), np.float32)
     p = str(tmp_path / "f.flo")

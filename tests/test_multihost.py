@@ -22,15 +22,15 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_shard_batch():
+def _run_children(child_name, extra_args=(), timeout=300):
     port = _free_port()
-    child = os.path.join(os.path.dirname(__file__), "_multihost_child.py")
+    child = os.path.join(os.path.dirname(__file__), child_name)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(child)))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
     env.pop("XLA_FLAGS", None)  # child sets its own device count (2)
     procs = [
         subprocess.Popen(
-            [sys.executable, child, str(port), str(i), "2"],
+            [sys.executable, child, str(port), str(i), "2", *extra_args],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=repo)
         for i in range(2)
@@ -38,7 +38,7 @@ def test_two_process_shard_batch():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -47,3 +47,20 @@ def test_two_process_shard_batch():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
         assert "OK" in out, out
+    return outs
+
+
+def test_two_process_shard_batch():
+    _run_children("_multihost_child.py")
+
+
+def test_two_process_train_preempt_resume(tmp_path):
+    """The pod-preemption path end-to-end on a 2-process distributed
+    "pod": real train() loops, a mid-epoch kill, emergency checkpoint,
+    auto-resume — final params must equal the uninterrupted run's
+    bit-for-bit (step + optimizer/LR + shuffle-position continuity)."""
+    outs = _run_children("_multihost_train_child.py",
+                         extra_args=(str(tmp_path),), timeout=1500)
+    for out in outs:
+        assert "preempted at step 3" in out, out
+        assert "resumed from step 3" in out, out
