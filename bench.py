@@ -127,7 +127,11 @@ def main():
         upsample_unroll=int(os.environ.get("BENCH_UPSAMPLE_UNROLL",
                                            _defaults.upsample_unroll)),
         upsample_dtype=os.environ.get("BENCH_UPSAMPLE_DTYPE",
-                                      _defaults.upsample_dtype))
+                                      _defaults.upsample_dtype),
+        fuse_upsample_in_scan=os.environ.get(
+            "BENCH_FUSE_UPSAMPLE", "0") == "1",
+        upsample_loss_kernel=os.environ.get("BENCH_UPSAMPLE_KERNEL",
+                                            _defaults.upsample_loss_kernel))
     cfg = TrainConfig(num_steps=1000, batch_size=B, image_size=(H, W),
                       iters=12)
 
@@ -184,7 +188,9 @@ def main():
         "config": {"batch_per_chip": per_chip_batch, "corr_impl": corr_impl,
                    "remat": remat,
                    "remat_upsample": model_cfg.remat_upsample,
-                   "scan_unroll": scan_unroll},
+                   "scan_unroll": scan_unroll,
+                   "fuse_upsample_in_scan": model_cfg.fuse_upsample_in_scan,
+                   "upsample_loss_kernel": model_cfg.upsample_loss_kernel},
     }))
 
 

@@ -114,6 +114,21 @@ class RAFTConfig:
     # iters/upsample_group steps) — the refinement scan's unroll lesson
     # applied to the second scan.
     upsample_unroll: int = 1
+    # Training upsample+loss implementation: 'xla' (convex_upsample_flat
+    # + compare, scan-stacked) or 'pallas' (ops/pallas_upsample.py — the
+    # whole softmax/FMA/compare chain per batch element in VMEM with a
+    # recomputing custom_vjp: no softmax intermediate ever reaches HBM).
+    # Eval always upsamples via XLA (it returns flows, not losses).
+    upsample_loss_kernel: str = "xla"
+    # Run the mask head + flat convex upsample + loss INSIDE the
+    # refinement scan (training fused-loss path only): the stacked
+    # (iters, B, H/8, W/8, hdim) GRU states never reach HBM (~560 MB of
+    # dynamic-update-slice writes + re-reads per step at chairs batch
+    # 16 — profiled ~10 ms/step of pure stacking traffic).  Param tree
+    # is unchanged (the in-scan body binds the same "refine" /
+    # "upsampler" scopes).  Eval and the stacked-flows API always use
+    # the two-scan form.
+    fuse_upsample_in_scan: bool = False
 
     @classmethod
     def full(cls, **kw) -> "RAFTConfig":

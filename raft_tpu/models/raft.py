@@ -59,6 +59,26 @@ from raft_tpu.ops.upsample import (convex_upsample, convex_upsample_flat,
                                    space_to_depth_flow)
 
 
+def _remat_wrap(target, cfg):
+    """Apply ``cfg.remat`` / ``cfg.remat_policy`` to a scan body (module
+    class or function form) — one dispatch shared by both training scan
+    shapes so a policy change can't silently diverge them."""
+    if not cfg.remat:
+        return target
+    if cfg.remat_policy == "dots":
+        return nn.remat(target,
+                        policy=jax.checkpoint_policies.dots_saveable)
+    if cfg.remat_policy == "save_corr":
+        return nn.remat(
+            target,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "corr", "motion"))
+    if cfg.remat_policy == "full":
+        return nn.remat(target)
+    raise ValueError(f"unknown remat_policy: {cfg.remat_policy!r} "
+                     "(expected 'full', 'dots' or 'save_corr')")
+
+
 class RefinementStep(nn.Module):
     """One GRU refinement iteration (the body of the reference's hot loop,
     raft.py:122-131; the upsample half of that loop lives in
@@ -169,6 +189,17 @@ class UpsampleLossStep(nn.Module):
         B = gt128.shape[0]
         g = net.shape[0] // B
         mask = MaskHead(cfg.hidden_dim, cfg.dtype, name="mask_head")(net)
+        if cfg.upsample_loss_kernel == "pallas":
+            from raft_tpu.ops.pallas_upsample import \
+                pallas_upsample_loss_sums
+
+            sums = pallas_upsample_loss_sums(flow, mask, gt128, vmask64)
+            return carry, jnp.sum(sums.reshape(g, B, 5), axis=1)
+        if cfg.upsample_loss_kernel != "xla":
+            raise ValueError(
+                f"unknown upsample_loss_kernel: "
+                f"{cfg.upsample_loss_kernel!r} (expected 'xla' or "
+                "'pallas')")
         out = convex_upsample_flat(flow, mask,
                                    compute_dtype=udt)  # (gB, H, W, 128)
         # The ground-truth COMPARE always runs fp32: with both sides in
@@ -269,23 +300,13 @@ class RAFT(nn.Module):
         if flow_init is not None:
             coords1 = coords1 + flow_init
 
-        step = RefinementStep
-        if cfg.remat:
-            if cfg.remat_policy == "dots":
-                step = nn.remat(
-                    RefinementStep,
-                    policy=jax.checkpoint_policies.dots_saveable)
-            elif cfg.remat_policy == "save_corr":
-                step = nn.remat(
-                    RefinementStep,
-                    policy=jax.checkpoint_policies.save_only_these_names(
-                        "corr", "motion"))
-            elif cfg.remat_policy == "full":
-                step = nn.remat(RefinementStep)
-            else:
-                raise ValueError(
-                    f"unknown remat_policy: {cfg.remat_policy!r} "
-                    "(expected 'full', 'dots' or 'save_corr')")
+        if (loss_targets is not None and not cfg.small and not test_mode
+                and cfg.fuse_upsample_in_scan):
+            return self._fused_inscan_losses(cfg, iters, net, inp, coords0,
+                                             coords1, corr_state,
+                                             loss_targets)
+
+        step = _remat_wrap(RefinementStep, cfg)
         scan = nn.scan(
             step,
             variable_broadcast="params",
@@ -354,16 +375,7 @@ class RAFT(nn.Module):
                 unroll=max(1, min(cfg.upsample_unroll, I // g)),
             )(cfg, name="upsampler")
             _, sums = up_scan(None, nets_r, flows_r, gt128, vmask64)
-            sums = sums.reshape(I, 5)
-            _, H8s, W8s, _ = gt128.shape
-            n_all = B * H8s * W8s * 128          # loss mean incl. zeroed
-            n_valid = jnp.maximum(jnp.sum(vmask64), 1.0)
-            per_iter = sums[:, 0] / n_all
-            metrics = {"epe": sums[-1, 1] / n_valid,
-                       "1px": sums[-1, 2] / n_valid,
-                       "3px": sums[-1, 3] / n_valid,
-                       "5px": sums[-1, 4] / n_valid}
-            return per_iter, metrics
+            return self._loss_outputs(sums.reshape(I, 5), gt128, vmask64, B)
 
         up_step = UpsampleStep
         if cfg.remat_upsample:
@@ -380,6 +392,57 @@ class RAFT(nn.Module):
         _, flow_ups = up_scan(None, nets_r, flows_r)
         flow_ups = flow_ups.reshape((I, B) + flow_ups.shape[2:])
         return flow_ups
+
+    @staticmethod
+    def _loss_outputs(sums, gt128, vmask64, B):
+        """Normalize the per-iteration ``(iters, 5)`` partial sums into
+        per-iteration mean losses + final-iteration metrics (reference
+        sequence_loss semantics, train.py:47-72)."""
+        _, H8s, W8s, _ = gt128.shape
+        n_all = B * H8s * W8s * 128              # loss mean incl. zeroed
+        n_valid = jnp.maximum(jnp.sum(vmask64), 1.0)
+        per_iter = sums[:, 0] / n_all
+        metrics = {"epe": sums[-1, 1] / n_valid,
+                   "1px": sums[-1, 2] / n_valid,
+                   "3px": sums[-1, 3] / n_valid,
+                   "5px": sums[-1, 4] / n_valid}
+        return per_iter, metrics
+
+    def _fused_inscan_losses(self, cfg, iters, net, inp, coords0, coords1,
+                             corr_state, loss_targets):
+        """Single-scan training path (``cfg.fuse_upsample_in_scan``): the
+        refinement step AND the mask head + flat convex upsample + loss
+        sums run in ONE scan body, so the per-iteration GRU states are
+        consumed in place instead of being stacked to HBM and re-read by
+        a second scan (~1.1 GB/step of stacking traffic at chairs batch
+        16).  The function-form ``nn.scan`` binds the same ``refine`` /
+        ``upsampler`` scopes as the two-scan path, so the param tree —
+        and every checkpoint — is identical."""
+        from raft_tpu.train.loss import combined_valid
+
+        flow_gt, valid, max_flow = loss_targets
+        B = flow_gt.shape[0]
+        vmask = combined_valid(flow_gt, valid, max_flow)
+        gt128 = space_to_depth_flow(flow_gt.astype(jnp.float32))
+        vmask64 = space_to_depth_flow(vmask[..., None])
+
+        def body(mdl, carry, _):
+            carry, (net_i, flow_i) = RefinementStep(cfg, name="refine")(
+                carry, (inp, coords0, corr_state))
+            _, sums = UpsampleLossStep(cfg, name="upsampler")(
+                None, net_i, flow_i, gt128, vmask64)
+            return carry, sums[0]
+
+        body = _remat_wrap(body, cfg)
+        scan = nn.scan(
+            body,
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": True},
+            length=iters,
+            unroll=cfg.scan_unroll,
+        )
+        _, sums = scan(self, (net, coords1), None)
+        return self._loss_outputs(sums, gt128, vmask64, B)
 
     def _small_outputs(self, flows, flow_low, test_mode, loss_targets):
         """Small-model upsampling: parameter-free ``upflow8`` applied to

@@ -98,7 +98,11 @@ def convex_upsample_flat(flow: jax.Array, mask: jax.Array,
         fk = f8[:, di:di + H, dj:dj + W, :]
         outx += e[k] * fk[..., 0:1]
         outy += e[k] * fk[..., 1:2]
-    return jnp.concatenate([outx / denom, outy / denom], axis=-1)
+    # One reciprocal + two muls instead of two 64-channel divides (TPU
+    # divide is a multi-pass VPU op; profiled ~4 ms/step across the 12
+    # iterations' forward+backward).
+    inv = 1.0 / denom
+    return jnp.concatenate([outx * inv, outy * inv], axis=-1)
 
 
 def space_to_depth_flow(x: jax.Array, factor: int = 8) -> jax.Array:
