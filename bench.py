@@ -129,7 +129,8 @@ def main():
         upsample_dtype=os.environ.get("BENCH_UPSAMPLE_DTYPE",
                                       _defaults.upsample_dtype),
         fuse_upsample_in_scan=os.environ.get(
-            "BENCH_FUSE_UPSAMPLE", "0") == "1",
+            "BENCH_FUSE_UPSAMPLE",
+            "1" if _defaults.fuse_upsample_in_scan else "0") == "1",
         upsample_loss_kernel=os.environ.get("BENCH_UPSAMPLE_KERNEL",
                                             _defaults.upsample_loss_kernel))
     cfg = TrainConfig(num_steps=1000, batch_size=B, image_size=(H, W),

@@ -34,7 +34,8 @@ Numerics contract (same as the XLA path, tests/test_pallas_upsample.py):
   and valid mask do not (they are data).
 
 Inputs are pre-arranged by the wrapper:
-- ``fb``  (gB, H+2, W+2, 128): flow * 8, edge-padded by 1, each of x/y
+- ``fb``  (gB, H+2, W+2, 128): flow * 8, ZERO-padded by 1 (matching
+  ``convex_upsample_flat`` and the reference's F.unfold), each of x/y
   broadcast to 64 lanes (lane halves) — so every one of the 9 tap
   windows is a static 2-D slice with the subpixel lanes already in
   place (in-kernel lane broadcasts of a width-in-lanes tensor would be
