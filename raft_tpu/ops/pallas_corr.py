@@ -55,6 +55,15 @@ VMEM sizing: beyond-HBM shapes auto-drop the ``f2`` blocks to bf16
 (fp32 accumulation) once fp32 ``f2`` + correlation scratch would
 exceed ~48 MB (``_odm_f2_dtype``) — at the 1440x2560 target the fp32
 form (~118 MB) cannot fit the budget.
+
+KNOWN LIMIT (measured round 3, BENCH_BEYOND_HBM_r03.json): on-demand
+TRAINING works single-chip up to 736x1280 (3.08 pairs/s/chip); at
+>=1088x1920 the BACKWARD kernel's per-level ``df2`` output window
+(one full level, e.g. f32 (1,180,320,256) = 56 MB at 1440x2560) plus
+register spills exceeds the 128 MB VMEM budget at compile time.  Fix
+path: block ``df2`` over f2-spatial tiles with output revisiting
+across the query grid, and emit ``df2`` in the f2 storage dtype.
+Eval/inference at those shapes is unaffected (fwd windows are small).
 """
 
 from __future__ import annotations
