@@ -125,6 +125,8 @@ def _bucket_hw(ds) -> tuple:
     key = tuple(p1 for (p1, _) in ds.image_list)
     hit = _BUCKET_CACHE.get(key)
     if hit is None:
+        if len(_BUCKET_CACHE) >= 64:   # a handful of dataset variants is
+            _BUCKET_CACHE.clear()      # the use case; don't grow forever
         hs, ws = zip(*(_peek_hw(p) for p in key))
         hit = _BUCKET_CACHE[key] = (-(-max(hs) // 8) * 8,
                                     -(-max(ws) // 8) * 8)

@@ -56,14 +56,27 @@ VMEM sizing: beyond-HBM shapes auto-drop the ``f2`` blocks to bf16
 exceed ~48 MB (``_odm_f2_dtype``) — at the 1440x2560 target the fp32
 form (~118 MB) cannot fit the budget.
 
-KNOWN LIMIT (measured round 3, BENCH_BEYOND_HBM_r03.json): on-demand
-TRAINING works single-chip up to 736x1280 (3.08 pairs/s/chip); at
->=1088x1920 the BACKWARD kernel's per-level ``df2`` output window
-(one full level, e.g. f32 (1,180,320,256) = 56 MB at 1440x2560) plus
-register spills exceeds the 128 MB VMEM budget at compile time.  Fix
-path: block ``df2`` over f2-spatial tiles with output revisiting
-across the query grid, and emit ``df2`` in the f2 storage dtype.
-Eval/inference at those shapes is unaffected (fwd windows are small).
+Backward tiling (round 4 — removes the round-3 VMEM ceiling): the
+FUSED backward holds every level's ``f2`` + ``df2`` + drows scratch in
+VMEM per instance, which stops compiling at >=1088x1920 (level 0 alone
+is 33-56 MB fp32, BENCH_BEYOND_HBM_r03.json).  ``_corr_bwd`` therefore
+estimates the fused residency and moves oversized levels onto a BLOCKED
+per-level pair (the TPU answer to the CUDA backward's tiled atomicAdd
+accumulation, correlation_kernel.cu:123-256):
+
+- ``_odm_bwd_df1_blocked_kernel``  grid (B, QB, TY): ``f2`` streams
+  through VMEM in ``(tile_h, Wl)`` row tiles; the ``df1`` block (index
+  constant across the innermost tile dim) accumulates in VMEM.
+- ``_odm_bwd_df2_blocked_kernel``  grid (B, TY, QB): one ``df2``
+  spatial tile (index constant across the innermost query dim)
+  accumulates in VMEM while f1/coords/g stream.
+
+Both kernels skip a (query block, row tile) pair entirely when no query
+window can overlap the tile's rows (``_tile_overlaps`` — a min/max
+bound on the block's ``cy``): ``drows`` for such a pair is exactly
+zero, so the skip is lossless, and because query blocks are
+raster-ordered their windows cluster in y — at bounded flow the dense
+contraction sparsifies by roughly Hl / (window + flow extent).
 """
 
 from __future__ import annotations
@@ -263,6 +276,193 @@ def _odm_bwd_kernel(*refs, levels, k, inv_scale):
     df1_ref[0] = df1
 
 
+# --- Blocked backward (beyond-HBM shapes) --------------------------------
+
+# Per-instance VMEM budget above which the fused backward stops being
+# offered a level (round 3 measured compile OOM at ~111 MB estimated
+# residency against the 100 MB limit; 736x1280 at ~51 MB compiles).
+_FUSED_BWD_BUDGET = 78 * 1024 * 1024
+_BWD_TILE_H = 8          # f2 rows per streamed tile
+_BWD_BLOCK_Q = 512       # query block of the blocked kernels (bigger than
+                         # the fused 128: f2 re-streams once per query
+                         # block in the df1 kernel, so fewer blocks =
+                         # proportionally less DMA)
+
+
+def _fused_bwd_est(nonempty, block_q, k):
+    """Estimated per-instance VMEM bytes of the FUSED backward: every
+    level's f2 + df2 + drows scratch resident, plus query blocks and the
+    b_j working set at the widest level."""
+    if not nonempty:
+        return 0
+    C = nonempty[0][1].shape[-1]
+    rows = sum(f2.shape[1] * f2.shape[2] for _, f2 in nonempty)
+    f2b = 2 if _odm_f2_dtype(nonempty, block_q) == jnp.bfloat16 else 4
+    wl0 = max(f2.shape[2] for _, f2 in nonempty)
+    return (rows * C * (f2b + 4)                 # f2 + fp32 df2
+            + rows * block_q * 4                 # drows scratch
+            + (k + 2) * wl0 * block_q * 4        # b_j + posx working set
+            + block_q * 4 * (2 * C + 2 + len(nonempty) * k * k))
+
+
+def _tile_overlaps(c_ref, lvl, r, tile_h, t):
+    """True iff ANY query in this block has a window row intersecting
+    f2 rows [t*tile_h, (t+1)*tile_h).  Each query touches rows
+    [cy - r - 1, cy + r + 1] (bilinear spreads one row past the tap
+    radius); padded queries sit at -1e6 and never extend the max."""
+    cy = c_ref[0, 1:2, :] * (1.0 / 2.0 ** lvl)
+    y0 = (t * tile_h).astype(jnp.float32)
+    return jnp.logical_and(jnp.max(cy) + (r + 1.0) >= y0,
+                           jnp.min(cy) - (r + 1.0) <= y0 + (tile_h - 1.0))
+
+
+def _bwd_window_rows(c_ref, g_ref, g_off, lvl, k, wl, tile_h, t,
+                     inv_scale):
+    """``drows`` for f2 rows [t*tile_h, (t+1)*tile_h) of one level:
+    (tile_h*wl, BQ).  Same math as the fused backward's ``_tile_rows``
+    but over a single streamed tile (tile_h is small and static, so no
+    fori loop and no scratch ref — one concatenate feeds one mat-mul).
+    ``g_off`` is this level's sublane offset into the full (L*k*k, BQ)
+    cotangent block (Mosaic requires sublane block dims divisible by 8
+    or whole, so the level can't be sliced by the block spec)."""
+    bq = c_ref.shape[2]
+    r = (k - 1) // 2
+    lvl_div = 1.0 / (2.0 ** lvl)
+    cx = c_ref[0, 0:1, :] * lvl_div     # (1, BQ) — 2-D, see fwd body
+    cy = c_ref[0, 1:2, :] * lvl_div
+    posx = jax.lax.broadcasted_iota(jnp.int32, (wl, bq), 0) \
+        .astype(jnp.float32)
+    b = [
+        sum(_tap_weight(cx, float(ti - r), posx)
+            * g_ref[0, g_off + ti * k + tj:g_off + ti * k + tj + 1, :]
+            for ti in range(k))
+        for tj in range(k)
+    ]                                    # K_j x (Wl, BQ)
+    y0f = (t * tile_h).astype(jnp.float32)
+    return jnp.concatenate([
+        sum(_tap_weight(cy, float(tj - r - yi), y0f) * b[tj]
+            for tj in range(k))
+        for yi in range(tile_h)
+    ], axis=0) * inv_scale               # (tile_h*Wl, BQ)
+
+
+def _odm_bwd_df1_blocked_kernel(f2_ref, c_ref, g_ref, df1_ref, *, lvl,
+                                g_off, wl, k, inv_scale, tile_h):
+    """df1 contribution of ONE blocked level; grid (B, QB, TY).  The df1
+    block index is constant across the innermost tile dim, so it
+    accumulates in VMEM while f2 streams tile by tile."""
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _():
+        df1_ref[0] = jnp.zeros_like(df1_ref[0])
+
+    @pl.when(_tile_overlaps(c_ref, lvl, (k - 1) // 2, tile_h, t))
+    def _():
+        drows = _bwd_window_rows(c_ref, g_ref, g_off, lvl, k, wl,
+                                 tile_h, t, inv_scale)
+        f2t = f2_ref[0].reshape(tile_h * wl, -1).astype(jnp.float32)
+        df1_ref[0] += jax.lax.dot_general(
+            drows, f2t, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (BQ, C)
+
+
+def _odm_bwd_df2_blocked_kernel(f1_ref, c_ref, g_ref, df2_ref, *, lvl,
+                                g_off, wl, k, inv_scale, tile_h):
+    """df2 of ONE blocked level; grid (B, TY, QB).  The df2 spatial tile
+    is constant across the innermost query dim, so it accumulates in
+    VMEM (sequential grid — no atomics, unlike correlation_kernel.cu:237)
+    while f1/coords/g stream."""
+    t = pl.program_id(1)
+    q = pl.program_id(2)
+
+    @pl.when(q == 0)
+    def _():
+        df2_ref[0] = jnp.zeros_like(df2_ref[0])
+
+    @pl.when(_tile_overlaps(c_ref, lvl, (k - 1) // 2, tile_h, t))
+    def _():
+        drows = _bwd_window_rows(c_ref, g_ref, g_off, lvl, k, wl,
+                                 tile_h, t, inv_scale)
+        f1 = f1_ref[0].astype(jnp.float32)               # (BQ, C)
+        df2_ref[0] += jax.lax.dot_general(
+            drows, f1, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).reshape(
+                tile_h, wl, -1)
+
+
+def _odm_bwd_blocked_level(lvl, f2, f1p, cpt, gp, k, inv_scale, block_q,
+                           interpret):
+    """Run the blocked kernel pair for one oversized level.
+
+    Args:
+      f2: this level's pooled target features ``(B, Hl, Wl, C)``.
+      f1p / cpt / gp: query features ``(B, Npad, C)``, centroids
+        ``(B, 2, Npad)`` and taps cotangent ``(B, L*k*k, Npad)``, all
+        padded to a multiple of ``block_q``.
+
+    Returns:
+      ``(df1_level (B, Npad, C), df2_level (B, Hl, Wl, C))`` fp32.
+    """
+    B, Hl, Wl, C = f2.shape
+    tile_h = min(_BWD_TILE_H, Hl)
+    Hp = -(-Hl // tile_h) * tile_h
+    TY = Hp // tile_h
+    Npad = f1p.shape[1]
+    QB = Npad // block_q
+    f2p = f2.astype(jnp.float32)
+    if Hp != Hl:
+        # Zero rows contribute zero to df1 regardless of tap weights, and
+        # the padded df2 rows are sliced away below — no in-kernel masks.
+        f2p = jnp.pad(f2p, ((0, 0), (0, Hp - Hl), (0, 0), (0, 0)))
+    vmem = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
+    lkk = gp.shape[1]
+    kern1 = functools.partial(_odm_bwd_df1_blocked_kernel, lvl=lvl,
+                              g_off=lvl * k * k, wl=Wl, k=k,
+                              inv_scale=inv_scale, tile_h=tile_h)
+    df1 = pl.pallas_call(
+        kern1,
+        grid=(B, QB, TY),
+        in_specs=[
+            pl.BlockSpec((1, tile_h, Wl, C), lambda b, q, t: (b, t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2, block_q), lambda b, q, t: (b, 0, q),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, lkk, block_q), lambda b, q, t: (b, 0, q),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, C), lambda b, q, t: (b, q, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, Npad, C), jnp.float32),
+        compiler_params=vmem,
+        interpret=interpret,
+    )(f2p, cpt, gp)
+
+    kern2 = functools.partial(_odm_bwd_df2_blocked_kernel, lvl=lvl,
+                              g_off=lvl * k * k, wl=Wl, k=k,
+                              inv_scale=inv_scale, tile_h=tile_h)
+    df2p = pl.pallas_call(
+        kern2,
+        grid=(B, TY, QB),
+        in_specs=[
+            pl.BlockSpec((1, block_q, C), lambda b, t, q: (b, q, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2, block_q), lambda b, t, q: (b, 0, q),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, lkk, block_q), lambda b, t, q: (b, 0, q),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tile_h, Wl, C),
+                               lambda b, t, q: (b, t, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, Hp, Wl, C), jnp.float32),
+        compiler_params=vmem,
+        interpret=interpret,
+    )(f1p, cpt, gp)
+    return df1, df2p[:, :Hl]
+
+
 def _pad_coords_oor(coords, npad):
     """Pad the query dim to ``npad`` with far-out-of-range centers — every
     window weight becomes zero (the sampler's zeros-padding semantics), so
@@ -285,7 +485,9 @@ def _pad_queries(f1, coords, block_q):
 
 
 def _auto_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from raft_tpu.ops.pallas_util import auto_interpret
+
+    return auto_interpret()
 
 
 # ---------------------------------------------------------------------------
@@ -713,61 +915,99 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
     N = H1 * W1
     k = 2 * radius + 1
     L = len(fmap2_pyramid)
+    inv_scale = 1.0 / float(C) ** 0.5
     f1 = fmap1.reshape(B, N, C).astype(jnp.float32)
     c = coords.reshape(B, N, 2).astype(jnp.float32)
-    f1p, cp, _ = _pad_queries(f1, c, block_q)
-    Npad = f1p.shape[1]
+    g_base = g.reshape(B, N, -1).transpose(0, 2, 1).astype(jnp.float32)
 
-    g = g.reshape(B, N, -1).transpose(0, 2, 1).astype(jnp.float32)
-    if Npad != N:
-        g = jnp.pad(g, ((0, 0), (0, 0), (0, Npad - N)))
+    # Partition levels: fused while the whole set fits the VMEM budget,
+    # biggest levels (level 0 first — pyramid sizes descend) onto the
+    # blocked per-level pair beyond it.  At <=736x1280 everything stays
+    # fused (status quo); 1088x1920+ moves level 0 (and, if ever needed,
+    # more) out — the round-3 compile ceiling.
+    nonempty, _ = _odm_levels(fmap2_pyramid, k)
+    fused = list(nonempty)
+    blocked = []
+    while fused and _fused_bwd_est(fused, block_q, k) > _FUSED_BWD_BUDGET:
+        blocked.append(fused.pop(0))
 
-    nonempty, levels = _odm_levels(fmap2_pyramid, k)
-    f2dt = _odm_f2_dtype(nonempty, block_q)
-    kern = functools.partial(_odm_bwd_kernel, levels=levels, k=k,
-                             inv_scale=1.0 / float(C) ** 0.5)
-    in_specs = [
-        pl.BlockSpec((1, f2.shape[1], f2.shape[2], C),
-                     lambda b, i: (b, 0, 0, 0), memory_space=pltpu.VMEM)
-        for _, f2 in nonempty
-    ] + [
-        pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
-                     memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, 2, block_q), lambda b, i: (b, 0, i),
-                     memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, L * k * k, block_q), lambda b, i: (b, 0, i),
-                     memory_space=pltpu.VMEM),
-    ]
-    out_specs = (pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
-                              memory_space=pltpu.VMEM),) + tuple(
-        pl.BlockSpec((1, f2.shape[1], f2.shape[2], C),
-                     lambda b, i: (b, 0, 0, 0), memory_space=pltpu.VMEM)
-        for _, f2 in nonempty)
-    out_shape = (jax.ShapeDtypeStruct((B, Npad, C), jnp.float32),) + tuple(
-        jax.ShapeDtypeStruct((B, f2.shape[1], f2.shape[2], C), jnp.float32)
-        for _, f2 in nonempty)
-    outs = pl.pallas_call(
-        kern,
-        grid=(B, Npad // block_q),
-        in_specs=in_specs,
-        out_specs=out_specs,
-        out_shape=out_shape,
-        scratch_shapes=[
-            pltpu.VMEM((f2.shape[1] * f2.shape[2], block_q), jnp.float32)
-            for _, f2 in nonempty
-        ],
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
-        interpret=interpret,
-    )(*[f2.astype(f2dt) for _, f2 in nonempty], f1p,
-      cp.transpose(0, 2, 1), g)
+    df1_acc = jnp.zeros((B, N, C), jnp.float32)
+    df2_by_level = {}
 
-    df1 = outs[0][:, :N].reshape(fmap1.shape).astype(fmap1.dtype)
+    if fused:
+        f1p, cp, _ = _pad_queries(f1, c, block_q)
+        Npad = f1p.shape[1]
+        gp = g_base
+        if Npad != N:
+            gp = jnp.pad(gp, ((0, 0), (0, 0), (0, Npad - N)))
+        levels = [(lvl, lvl * k * k, f2.shape[1], f2.shape[2])
+                  for lvl, f2 in fused]
+        f2dt = _odm_f2_dtype(fused, block_q)
+        kern = functools.partial(_odm_bwd_kernel, levels=levels, k=k,
+                                 inv_scale=inv_scale)
+        in_specs = [
+            pl.BlockSpec((1, f2.shape[1], f2.shape[2], C),
+                         lambda b, i: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM)
+            for _, f2 in fused
+        ] + [
+            pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2, block_q), lambda b, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, L * k * k, block_q), lambda b, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+        ]
+        out_specs = (pl.BlockSpec((1, block_q, C), lambda b, i: (b, i, 0),
+                                  memory_space=pltpu.VMEM),) + tuple(
+            pl.BlockSpec((1, f2.shape[1], f2.shape[2], C),
+                         lambda b, i: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM)
+            for _, f2 in fused)
+        out_shape = (jax.ShapeDtypeStruct((B, Npad, C),
+                                          jnp.float32),) + tuple(
+            jax.ShapeDtypeStruct((B, f2.shape[1], f2.shape[2], C),
+                                 jnp.float32)
+            for _, f2 in fused)
+        outs = pl.pallas_call(
+            kern,
+            grid=(B, Npad // block_q),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[
+                pltpu.VMEM((f2.shape[1] * f2.shape[2], block_q),
+                           jnp.float32)
+                for _, f2 in fused
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=interpret,
+        )(*[f2.astype(f2dt) for _, f2 in fused], f1p,
+          cp.transpose(0, 2, 1), gp)
+        df1_acc = df1_acc + outs[0][:, :N]
+        for (lvl, _), out in zip(fused, outs[1:]):
+            df2_by_level[lvl] = out
+
+    if blocked:
+        bq2 = _BWD_BLOCK_Q
+        f1p2, cp2, _ = _pad_queries(f1, c, bq2)
+        Npad2 = f1p2.shape[1]
+        gp2 = g_base
+        if Npad2 != N:
+            gp2 = jnp.pad(gp2, ((0, 0), (0, 0), (0, Npad2 - N)))
+        cpt2 = cp2.transpose(0, 2, 1)
+        for lvl, f2 in blocked:
+            df1_l, df2_l = _odm_bwd_blocked_level(
+                lvl, f2, f1p2, cpt2, gp2, k, inv_scale, bq2, interpret)
+            df1_acc = df1_acc + df1_l[:, :N]
+            df2_by_level[lvl] = df2_l
+
+    df1 = df1_acc.reshape(fmap1.shape).astype(fmap1.dtype)
     df2s = []
-    it = iter(outs[1:])
-    for f2 in fmap2_pyramid:
-        if f2.shape[1] > 0 and f2.shape[2] > 0:
-            df2s.append(next(it).astype(f2.dtype))
+    for lvl, f2 in enumerate(fmap2_pyramid):
+        if lvl in df2_by_level:
+            df2s.append(df2_by_level[lvl].astype(f2.dtype))
         else:
             df2s.append(jnp.zeros_like(f2))
     # coords gradient is structurally zero (reference detaches coords each

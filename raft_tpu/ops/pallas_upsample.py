@@ -140,10 +140,15 @@ def _upsample_loss_bwd_kernel(fb_ref, mask_ref, gt_ref, vm_ref, g_ref,
     # ("Broadcast in both sublanes and lanes"); a rank-0 scalar rides
     # the scalar registers instead.
     dl1 = g_ref[0, 0, 0]
-    # d l1 / d out = vm * sign(out - gt); metrics lanes are
-    # non-differentiable by contract (ignored).
-    gx = vm * jnp.sign(outx - gt[:, :, :64]) * dl1
-    gy = vm * jnp.sign(outy - gt[:, :, 64:]) * dl1
+    # d l1 / d out = vm * dabs(out - gt); metrics lanes are
+    # non-differentiable by contract (ignored).  dabs uses jnp.abs's VJP
+    # convention (+1 at exactly zero) rather than jnp.sign (0 at zero) so
+    # the kernel's subgradient matches the XLA loss path bit-for-bit even
+    # on exactly-zero residuals (reachable with integer synthetic flows).
+    dabs_x = jnp.where(outx >= gt[:, :, :64], 1.0, -1.0)
+    dabs_y = jnp.where(outy >= gt[:, :, 64:], 1.0, -1.0)
+    gx = vm * dabs_x * dl1
+    gy = vm * dabs_y * dl1
     gout = gx * outx + gy * outy
     scratch_ref[...] = jnp.zeros((H + 2, W + 2, 128), jnp.float32)
     for k in range(9):
@@ -228,7 +233,9 @@ _upsample_loss_core.defvjp(_core_fwd, _core_bwd)
 
 
 def _auto_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from raft_tpu.ops.pallas_util import auto_interpret
+
+    return auto_interpret()
 
 
 def pallas_upsample_loss_sums(flow: jax.Array, mask: jax.Array,

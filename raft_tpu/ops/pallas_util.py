@@ -1,0 +1,32 @@
+"""Shared Pallas dispatch helpers."""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+
+_warned_interpret = False
+
+
+def auto_interpret() -> bool:
+    """Interpret mode for every non-TPU backend.
+
+    Meant for the CPU test backend; on an accelerator backend that is
+    not a TPU (e.g. GPU) the interpreter would be pathologically slow,
+    so warn once — callers there should prefer the XLA paths
+    (``corr_impl='allpairs'``/``'chunked'``, ``convex_upsample_flat`` +
+    ``sequence_loss``).
+    """
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return False
+    if backend != "cpu":
+        global _warned_interpret
+        if not _warned_interpret:
+            _warned_interpret = True
+            warnings.warn(
+                f"Pallas kernels on backend {backend!r} run in the (very "
+                "slow) Pallas interpreter; prefer the XLA implementations "
+                "on this backend", stacklevel=3)
+    return True

@@ -37,6 +37,7 @@ from raft_tpu.utils.profiling import StepProfiler, annotate_step
 import threading
 
 _PREEMPT = threading.Event()
+_warned_sync = False
 
 
 def request_preemption() -> None:
@@ -61,7 +62,15 @@ def _reached_preemption_sync(step: int) -> bool:
 
     try:
         return multihost_utils.reached_preemption_sync_point(step)
-    except RuntimeError:  # jax_enable_preemption_service disabled
+    except Exception as e:  # service disabled/unavailable; JAX versions
+        # differ in what they raise here.  Log once: this is a cross-host
+        # sync point, and silently returning False on only SOME hosts
+        # would desynchronize their exit steps.
+        global _warned_sync
+        if not _warned_sync:
+            _warned_sync = True
+            print(f"preemption sync unavailable ({type(e).__name__}: {e});"
+                  " falling back to no multi-host preemption", flush=True)
         return False
 
 
@@ -156,6 +165,15 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             profiler.maybe_stop(step, sync_on=metrics.get("loss"))
             step += 1
             logger.push(step - 1, metrics)
+
+            # Second preemption check before the (potentially minutes-
+            # long) save+validate block, so a SIGTERM during the step
+            # exits here instead of after full validation.  Caveat: a
+            # SIGTERM while the data loader itself is blocked in
+            # ``next(batches)`` is only observed once the loader yields —
+            # the flag cannot interrupt host-side IO.
+            if _PREEMPT.is_set():
+                raise SystemExit(143)
 
             if step % cfg.val_freq == 0:
                 mgr.save(step, state)

@@ -70,3 +70,34 @@ class StepProfiler:
 def annotate_step(step: int):
     """Named step annotation shown on the XProf timeline."""
     return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
+def hbm_usage(compiled_or_fn, *args) -> dict:
+    """True HBM accounting for a jitted step, portable across backends.
+
+    ``device.memory_stats()`` returns ``None`` on some platforms (the
+    tunneled TPU backend here) and ``jax.profiler.device_memory_profile``
+    can crash them outright, so runtime peak polling is not a reliable
+    source.  XLA's buffer assignment is: the compiled executable knows its
+    exact peak device allocation (arguments + outputs + temps, with
+    donation already applied).  Pass either an already-``.compile()``d
+    executable or a jitted function plus example args.
+
+    Returns a dict with GiB figures, or ``{"peak_hbm": "unavailable"}``
+    if the executable does not expose memory analysis.
+    """
+    try:
+        compiled = (compiled_or_fn if not args
+                    else compiled_or_fn.lower(*args).compile())
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return {"peak_hbm": "unavailable"}
+        gib = float(2 ** 30)
+        return {
+            "peak_hbm_gb": round(ma.peak_memory_in_bytes / gib, 3),
+            "args_gb": round(ma.argument_size_in_bytes / gib, 3),
+            "output_gb": round(ma.output_size_in_bytes / gib, 3),
+            "temp_gb": round(ma.temp_size_in_bytes / gib, 3),
+        }
+    except Exception as e:  # pragma: no cover - backend-specific
+        return {"peak_hbm": f"unavailable ({type(e).__name__})"}

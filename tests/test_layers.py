@@ -99,6 +99,7 @@ def test_folded_batch_norm_training_stats_match():
                                    np.asarray(leaf), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # ~50 s: full-encoder fwd+bwd traces
 def test_encoder_folded_matches_unfolded_and_gradients():
     from raft_tpu.models.extractor import BasicEncoder
 

@@ -54,6 +54,7 @@ def test_two_process_shard_batch():
     _run_children("_multihost_child.py")
 
 
+@pytest.mark.slow  # ~13 min on this 1-core container: 2-process e2e
 def test_two_process_train_preempt_resume(tmp_path):
     """The pod-preemption path end-to-end on a 2-process distributed
     "pod": real train() loops, a mid-epoch kill, emergency checkpoint,

@@ -77,6 +77,75 @@ def test_gradients_match_xla_path():
                                    rtol=1e-4, atol=1e-4)
 
 
+def _grad_pair(coords, f1, f2):
+    def loss_pallas(f1_, f2_):
+        pyr = tuple(pool_fmap_pyramid(f2_, LEVELS))
+        out = pallas_corr_lookup(f1_, pyr, coords, RADIUS, 64)
+        return jnp.sum(jnp.sin(out))
+
+    def loss_xla(f1_, f2_):
+        pyr = build_corr_pyramid(f1_, f2_, LEVELS)
+        out = corr_lookup(pyr, coords, RADIUS)
+        return jnp.sum(jnp.sin(out))
+
+    return (jax.grad(loss_pallas, argnums=(0, 1))(f1, f2),
+            jax.grad(loss_xla, argnums=(0, 1))(f1, f2))
+
+
+def test_blocked_bwd_all_levels_match_xla(monkeypatch):
+    """Force EVERY level onto the blocked backward pair (the beyond-HBM
+    tiling, round-4): gradients must still match the XLA path."""
+    from raft_tpu.ops import pallas_corr as pc
+
+    monkeypatch.setattr(pc, "_FUSED_BWD_BUDGET", 0)
+    monkeypatch.setattr(pc, "_BWD_BLOCK_Q", 64)
+    f1, f2, coords = _setup(7)
+    gp, gx = _grad_pair(coords, f1, f2)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_blocked_bwd_mixed_partition_matches_xla(monkeypatch):
+    """Budget admits only the SMALL levels into the fused kernel, so
+    level 0 runs blocked while levels 1.. stay fused — the partition the
+    auto heuristic picks at 1088x1920+."""
+    from raft_tpu.ops import pallas_corr as pc
+    from raft_tpu.ops.corr import pool_fmap_pyramid as pool
+
+    f1, f2, coords = _setup(8)
+    nonempty = [(lvl, x) for lvl, x in enumerate(pool(f2, LEVELS))]
+    k = 2 * RADIUS + 1
+    small_est = pc._fused_bwd_est(nonempty[1:], 64, k)
+    full_est = pc._fused_bwd_est(nonempty, 64, k)
+    assert small_est < full_est
+    monkeypatch.setattr(pc, "_FUSED_BWD_BUDGET", small_est + 1)
+    monkeypatch.setattr(pc, "_BWD_BLOCK_Q", 64)
+    gp, gx = _grad_pair(coords, f1, f2)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_blocked_bwd_large_flow_offsets(monkeypatch):
+    """Blocked backward with coords far from the raster grid (windows in
+    arbitrary tiles, some fully out of range) — exercises the
+    _tile_overlaps skip logic for both hit and miss tiles."""
+    from raft_tpu.ops import pallas_corr as pc
+
+    monkeypatch.setattr(pc, "_FUSED_BWD_BUDGET", 0)
+    monkeypatch.setattr(pc, "_BWD_BLOCK_Q", 64)
+    rng = np.random.default_rng(9)
+    f1 = jnp.asarray(rng.standard_normal((B, H, W, C)), jnp.float32)
+    f2 = jnp.asarray(rng.standard_normal((B, H, W, C)), jnp.float32)
+    coords = coords_grid(B, H, W) + jnp.asarray(
+        rng.uniform(-20, 20, (B, H, W, 2)), jnp.float32)
+    gp, gx = _grad_pair(coords, f1, f2)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
 def test_coords_gradient_is_zero():
     f1, f2, coords = _setup(4)
     f2_pyr = tuple(pool_fmap_pyramid(f2, LEVELS))
