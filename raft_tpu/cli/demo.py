@@ -34,7 +34,22 @@ def parse_args(argv=None):
 def main(argv=None):
     args = parse_args(argv)
     if args.path is None:
-        args.path = "data_abel" if osp.isdir("data_abel") else "demo-frames"
+        if osp.isdir("data_abel"):       # the fork's sample (demo.py:69)
+            args.path = "data_abel"
+        elif osp.isdir("demo-frames"):
+            args.path = "demo-frames"
+        else:
+            # bare clone, cwd elsewhere: the repo bundles a procedural
+            # sample (regenerable via scripts/make_demo_frames.py) next
+            # to the package.
+            args.path = osp.join(osp.dirname(osp.dirname(
+                osp.dirname(osp.abspath(__file__)))), "demo-frames")
+            if not osp.isdir(args.path):
+                raise SystemExit(
+                    f"no frame directory: pass --path, or generate the "
+                    f"bundled sample with scripts/make_demo_frames.py "
+                    f"(looked for ./data_abel, ./demo-frames, "
+                    f"{args.path})")
 
     import jax.numpy as jnp
     import numpy as np

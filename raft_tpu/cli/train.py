@@ -60,7 +60,8 @@ def parse_args(argv=None):
                    help="checkpoint + validation cadence in steps "
                         "(reference VAL_FREQ, train.py:159)")
     p.add_argument("--remat", default="save_corr",
-                   choices=["save_corr", "full", "dots", "none"],
+                   choices=["save_corr", "save_corr_upsample", "full",
+                            "dots", "none"],
                    help="backward rematerialization of the refinement "
                         "scan. 'none' is fastest when the activations "
                         "fit (59.5 vs 55.8 pairs/s/chip at the chairs "
@@ -73,6 +74,12 @@ def parse_args(argv=None):
                         "backward. 0 is faster when its residuals fit "
                         "(+11%% at the things crop batch 8/chip, v5e "
                         "round 3); 1 (default) is the safe choice")
+    p.add_argument("--corr_dtype", default="auto",
+                   choices=["auto", "float32", "bfloat16"],
+                   help="materialized corr-pyramid storage dtype; 'auto' "
+                        "follows the compute dtype (bf16 storage under "
+                        "bf16 compute), 'float32' pins fp32 like the "
+                        "reference (core/corr.py:50)")
     p.add_argument("--corr_impl", default="auto",
                    choices=["auto", "allpairs", "allpairs_pallas",
                             "chunked", "pallas"],
@@ -157,6 +164,7 @@ def main(argv=None):
     mk = RAFTConfig.small_model if args.small else RAFTConfig.full
     model_cfg = mk(dropout=args.dropout, corr_impl=corr_impl,
                    compute_dtype=compute_dtype,
+                   corr_dtype=args.corr_dtype,
                    remat=args.remat != "none",
                    remat_policy=args.remat if args.remat != "none"
                    else "save_corr",

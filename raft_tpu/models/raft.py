@@ -73,10 +73,23 @@ def _remat_wrap(target, cfg):
             target,
             policy=jax.checkpoint_policies.save_only_these_names(
                 "corr", "motion"))
+    if cfg.remat_policy == "save_corr_upsample":
+        # For the single-scan fused path (``fuse_upsample_in_scan``):
+        # additionally save the mask-head logits, so the backward does
+        # not re-run the 128->256->576 mask convs per iteration — the
+        # recompute that made fused+save_corr 13% SLOWER than two scans
+        # at the things crop in round 3 (~40-47 MB bf16 per iteration of
+        # saves at stage crops; the softmax/FMA upsample chain itself
+        # still recomputes).
+        return nn.remat(
+            target,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "corr", "motion", "mask"))
     if cfg.remat_policy == "full":
         return nn.remat(target)
     raise ValueError(f"unknown remat_policy: {cfg.remat_policy!r} "
-                     "(expected 'full', 'dots' or 'save_corr')")
+                     "(expected 'full', 'dots', 'save_corr' or "
+                     "'save_corr_upsample')")
 
 
 class RefinementStep(nn.Module):
@@ -189,6 +202,9 @@ class UpsampleLossStep(nn.Module):
         B = gt128.shape[0]
         g = net.shape[0] // B
         mask = MaskHead(cfg.hidden_dim, cfg.dtype, name="mask_head")(net)
+        # Tagged so remat_policy='save_corr_upsample' can pin the logits
+        # (no-op under the other policies / outside remat).
+        mask = checkpoint_name(mask, "mask")
         if cfg.upsample_loss_kernel == "pallas":
             from raft_tpu.ops.pallas_upsample import \
                 pallas_upsample_loss_sums

@@ -392,7 +392,7 @@ def _odm_bwd_df2_blocked_kernel(f1_ref, c_ref, g_ref, df2_ref, *, lvl,
 
 
 def _odm_bwd_blocked_level(lvl, f2, f1p, cpt, gp, k, inv_scale, block_q,
-                           interpret):
+                           interpret, f2_dtype=jnp.float32):
     """Run the blocked kernel pair for one oversized level.
 
     Args:
@@ -400,6 +400,10 @@ def _odm_bwd_blocked_level(lvl, f2, f1p, cpt, gp, k, inv_scale, block_q,
       f1p / cpt / gp: query features ``(B, Npad, C)``, centroids
         ``(B, 2, Npad)`` and taps cotangent ``(B, L*k*k, Npad)``, all
         padded to a multiple of ``block_q``.
+      f2_dtype: streaming dtype for f2 (the df1 kernel re-streams the
+        whole level once per query block, so bf16 — what the fused path
+        stores at beyond-HBM shapes anyway — halves the dominant DMA;
+        the kernel accumulates fp32 regardless).
 
     Returns:
       ``(df1_level (B, Npad, C), df2_level (B, Hl, Wl, C))`` fp32.
@@ -410,7 +414,7 @@ def _odm_bwd_blocked_level(lvl, f2, f1p, cpt, gp, k, inv_scale, block_q,
     TY = Hp // tile_h
     Npad = f1p.shape[1]
     QB = Npad // block_q
-    f2p = f2.astype(jnp.float32)
+    f2p = f2.astype(f2_dtype)
     if Hp != Hl:
         # Zero rows contribute zero to df1 regardless of tap weights, and
         # the padded df2 rows are sliced away below — no in-kernel masks.
@@ -512,10 +516,18 @@ def _auto_interpret() -> bool:
 _ROW_TILE = 8
 
 
-def _pyr_fwd_level_body(corr_ref, c_ref, out_ref, lvl, out_off, hl, wl, k):
+def _pyr_fwd_level_body(corr_ref, c_ref, out_ref, acc_ref, lvl, out_off,
+                        hl, wl, k):
     """One level's forward sampling inside the fused kernel (QUERY-MINOR:
     queries live in lanes, x in sublanes): write ``(k*k, BQ)`` taps at
     sublane offset ``out_off`` of ``out_ref``.
+
+    Tap accumulation lives in a ``(k*wl, BQ)`` VMEM scratch ref (not
+    loop-carried registers) so each row tile can be SKIPPED outright
+    when no query window reaches its rows — queries are raster-ordered,
+    so one block's ``cy`` spans ~2 image rows plus flow, and at bounded
+    flow most of the image contributes nothing to a block's taps (round
+    4: same bound as the blocked backward's ``_tile_overlaps``).
 
     corr_ref: (1, hl, wl, BQ); c_ref: (1, 2, BQ); out: (1, L*k*k, BQ)."""
     bq = c_ref.shape[2]
@@ -529,35 +541,44 @@ def _pyr_fwd_level_body(corr_ref, c_ref, out_ref, lvl, out_off, hl, wl, k):
 
     T = min(_ROW_TILE, hl)
     nt = hl // T
+    # Window bounds hoisted out of the tile loop: one min/max pair per
+    # level instead of per tile.  Padded queries sit at -1e6: they relax
+    # the lower bound but never extend the upper one.
+    ymax = jnp.max(cy) + (r + 1.0)
+    ymin = jnp.min(cy) - (r + 1.0)
 
-    def tile_body(t, accs):
-        blk = corr_ref[0, pl.ds(t * T, T), :, :]     # (T, wl, BQ)
+    acc_ref[...] = jnp.zeros((k * wl, bq), jnp.float32)
+
+    def tile_body(t, _):
         y0 = (t * T).astype(jnp.float32)
-        for yi in range(T):
-            # fp32 accumulation regardless of the stored pyramid dtype
-            # (corr_dtype='bfloat16' halves the HBM read traffic; the
-            # convert rides the VMEM load).
-            row = blk[yi, :, :].astype(jnp.float32)
-            for j in range(k):
-                accs[j] += _tap_weight(cy, float(j - r - yi), y0) * row
-        return accs
 
-    accs = jax.lax.fori_loop(
-        0, nt, tile_body,
-        [jnp.zeros((wl, bq), jnp.float32) for _ in range(k)])
+        @pl.when(jnp.logical_and(ymax >= y0, ymin <= y0 + (T - 1.0)))
+        def _():
+            blk = corr_ref[0, pl.ds(t * T, T), :, :]     # (T, wl, BQ)
+            for yi in range(T):
+                # fp32 accumulation regardless of the stored pyramid
+                # dtype (corr_dtype='bfloat16' halves the HBM read
+                # traffic; the convert rides the VMEM load).
+                row = blk[yi, :, :].astype(jnp.float32)
+                for j in range(k):
+                    acc_ref[j * wl:(j + 1) * wl, :] += _tap_weight(
+                        cy, float(j - r - yi), y0) * row
+        return 0
+
+    jax.lax.fori_loop(0, nt, tile_body, 0)
     if hl % T:
         rem = nt * T
         blk = corr_ref[0, rem:, :, :]
         for yi in range(hl - rem):
             row = blk[yi, :, :].astype(jnp.float32)
             for j in range(k):
-                accs[j] += _tap_weight(cy, float(j - r - yi),
-                                       float(rem)) * row
+                acc_ref[j * wl:(j + 1) * wl, :] += _tap_weight(
+                    cy, float(j - r - yi), float(rem)) * row
 
     for i in range(k):
         for j in range(k):
             out_ref[0, out_off + i * k + j:out_off + i * k + j + 1, :] = \
-                jnp.sum(wx[i] * accs[j], axis=0,
+                jnp.sum(wx[i] * acc_ref[j * wl:(j + 1) * wl, :], axis=0,
                         keepdims=True).astype(out_ref.dtype)
 
 
@@ -580,6 +601,11 @@ def _pyr_bwd_level_body(c_ref, g_ref, dcorr_ref, lvl, g_off, hl, wl, k):
 
     T = min(_ROW_TILE, hl)
     nt = hl // T
+    # Same per-tile window bound as the forward: tiles no query window
+    # reaches get a plain zero store instead of the 9-FMA-per-row
+    # construction (the write itself cannot be skipped — dcorr is dense).
+    ymax = jnp.max(cy) + (r + 1.0)
+    ymin = jnp.min(cy) - (r + 1.0)
 
     def _rows(y0f, yis):
         return jnp.stack([
@@ -588,8 +614,18 @@ def _pyr_bwd_level_body(c_ref, g_ref, dcorr_ref, lvl, g_off, hl, wl, k):
         ], axis=0)                                   # (T, wl, BQ)
 
     def tile_body(t, _):
-        dcorr_ref[0, pl.ds(t * T, T), :, :] = _rows(
-            (t * T).astype(jnp.float32), range(T)).astype(dcorr_ref.dtype)
+        y0 = (t * T).astype(jnp.float32)
+        hit = jnp.logical_and(ymax >= y0, ymin <= y0 + (T - 1.0))
+
+        @pl.when(hit)
+        def _():
+            dcorr_ref[0, pl.ds(t * T, T), :, :] = _rows(
+                y0, range(T)).astype(dcorr_ref.dtype)
+
+        @pl.when(jnp.logical_not(hit))
+        def _():
+            dcorr_ref[0, pl.ds(t * T, T), :, :] = jnp.zeros(
+                (T, wl, bq), dcorr_ref.dtype)
         return 0
 
     jax.lax.fori_loop(0, nt, tile_body, 0)
@@ -604,12 +640,17 @@ def _pyr_multi_fwd_kernel(*refs, levels, k, kk_total):
     the per-call overhead of one pallas_call per level per direction
     (~200 calls/step at unroll 6) costing as much as the level-0 math —
     the small levels were pure overhead.  ``levels``: static list of
-    ``(lvl, out_off, hl, wl)``; refs = [corr_0..corr_{n-1}, c, out]."""
-    c_ref, out_ref = refs[-2], refs[-1]
+    ``(lvl, out_off, hl, wl)``; refs = [corr_0..corr_{n-1}, c, out,
+    acc_0..acc_{n-1}]."""
+    nl = len(levels)
+    c_ref, out_ref = refs[nl], refs[nl + 1]
+    acc_refs = refs[nl + 2:]
     bq = c_ref.shape[2]
     covered = 0
-    for (lvl, off, hl, wl), corr_ref in zip(levels, refs[:-2]):
-        _pyr_fwd_level_body(corr_ref, c_ref, out_ref, lvl, off, hl, wl, k)
+    for (lvl, off, hl, wl), corr_ref, acc_ref in zip(levels, refs[:nl],
+                                                     acc_refs):
+        _pyr_fwd_level_body(corr_ref, c_ref, out_ref, acc_ref, lvl, off,
+                            hl, wl, k)
         covered += k * k
     if covered < kk_total:  # empty (over-pooled) trailing levels -> zeros
         out_ref[0, covered:, :] = jnp.zeros((kk_total - covered, bq),
@@ -654,6 +695,10 @@ def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret,
                                lambda b, i: (b, 0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, L * k * k, Npad), out_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((k * c.shape[2], block_q), jnp.float32)
+            for _, c in nonempty
+        ],
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
@@ -997,9 +1042,15 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
         if Npad2 != N:
             gp2 = jnp.pad(gp2, ((0, 0), (0, 0), (0, Npad2 - N)))
         cpt2 = cp2.transpose(0, 2, 1)
+        # Stream f2 in the dtype the FUSED path would store for the whole
+        # pyramid (bf16 at beyond-HBM shapes, fp32 at small ones) — the
+        # df1 kernel re-reads the level once per query block, so the
+        # dtype is the dominant DMA knob.
+        f2dt_blocked = _odm_f2_dtype(nonempty, block_q)
         for lvl, f2 in blocked:
             df1_l, df2_l = _odm_bwd_blocked_level(
-                lvl, f2, f1p2, cpt2, gp2, k, inv_scale, bq2, interpret)
+                lvl, f2, f1p2, cpt2, gp2, k, inv_scale, bq2, interpret,
+                f2_dtype=f2dt_blocked)
             df1_acc = df1_acc + df1_l[:, :N]
             df2_by_level[lvl] = df2_l
 

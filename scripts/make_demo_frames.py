@@ -1,0 +1,73 @@
+"""Regenerate the bundled ``demo-frames/`` sample (3 frames = 2 pairs).
+
+The reference ships real sample imagery (``demo-frames/`` Sintel stills
+and the fork's ``data_abel/`` street pair, reference demo.py:69,77-78);
+this repo cannot copy those, so it bundles a PROCEDURAL street-like
+scene instead: sky gradient, panning textured ground, parallax skyline,
+independently moving circles, and a crossing "car" — enough structure
+for RAFT to produce a readable colorwheel flow image in a bare clone.
+
+Deterministic (fixed seeds).  Usage:
+    python scripts/make_demo_frames.py [outdir=demo-frames]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import cv2
+import numpy as np
+
+H, W = 384, 512
+
+
+def main():
+    out = sys.argv[1] if len(sys.argv) > 1 else "demo-frames"
+    import os
+
+    os.makedirs(out, exist_ok=True)
+    rng = np.random.default_rng(42)
+    rng2 = np.random.default_rng(7)
+
+    bldgs = [(int(x), int(w), int(h), tuple(map(float, c)))
+             for x, w, h, c in zip(rng.integers(0, W, 12),
+                                   rng.integers(30, 80, 12),
+                                   rng.integers(40, 140, 12),
+                                   rng.uniform(70, 130, (12, 3)))]
+    objs = [(float(x), float(y), int(r), tuple(map(float, c)), float(vx),
+             float(vy))
+            for x, y, r, c, vx, vy in zip(
+                rng.uniform(50, W - 50, 5), rng.uniform(60, 170, 5),
+                rng.integers(10, 26, 5), rng.uniform(120, 240, (5, 3)),
+                rng.uniform(-12, 12, 5), rng.uniform(-4, 4, 5))]
+    gsmall = rng2.uniform(60, 140, (24, 40, 3))
+    ground = cv2.resize(gsmall, (W * 2, H), interpolation=cv2.INTER_CUBIC)
+
+    def scene(t):
+        img = np.zeros((H, W, 3), np.float32)
+        sky = np.linspace([120, 170, 230], [200, 220, 245], H // 2)
+        img[:H // 2] = sky[:, None, :]
+        pan = 6 * t                      # camera pans right 6 px/frame
+        img[H // 2:] = ground[H // 2:, pan:pan + W]
+        for bx, bw, bh, c in bldgs:      # skyline: 2 px/frame parallax
+            x0 = bx - 2 * t
+            cv2.rectangle(img, (x0, H // 2 - bh), (x0 + bw, H // 2), c, -1)
+        for cx, cy, r, c, vx, vy in objs:
+            cv2.circle(img, (int(cx + vx * t), int(cy + vy * t)), r, c, -1)
+        x = 60 + 18 * t                  # crossing "car"
+        cv2.rectangle(img, (x, 250), (x + 90, 300), (30, 30, 160), -1)
+        cv2.rectangle(img, (x + 15, 230), (x + 70, 252), (60, 60, 190), -1)
+        cv2.circle(img, (x + 20, 300), 12, (25, 25, 25), -1)
+        cv2.circle(img, (x + 70, 300), 12, (25, 25, 25), -1)
+        # static film grain: photometrically consistent across frames
+        img += np.random.default_rng(100).normal(0, 3, img.shape)
+        return np.clip(img, 0, 255).astype(np.uint8)
+
+    for t in range(3):
+        cv2.imwrite(f"{out}/frame_{t:04d}.png",
+                    cv2.cvtColor(scene(t), cv2.COLOR_RGB2BGR))
+    print(f"wrote 3 frames to {out}/")
+
+
+if __name__ == "__main__":
+    main()
