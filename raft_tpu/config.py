@@ -61,10 +61,15 @@ class RAFTConfig:
     # dtype, corr.py:50, preserved whenever the model computes fp32).
     corr_dtype: str = "auto"
     # MXU precision for the correlation matmul + window-sampling einsums:
-    # 'default' (1 bf16 pass), 'high' (bf16x3), 'highest' (fp32 —
-    # measured FASTER than bf16x3 on v5e, and the reference keeps corr
-    # fp32, corr.py:50).
-    corr_precision: str = "highest"
+    # 'default' (1 bf16 pass), 'high' (bf16x3), 'highest' (fp32), or
+    # 'auto' (= 'highest').  Counterintuitive v5e measurements, twice
+    # confirmed: 'highest' beats 'high' (round 1) AND beats 'default'
+    # (round 4: 76.0 vs 74.1 pairs/s end-to-end) — even though under
+    # bf16 compute the fmaps are bf16-exact and 'default' is bitwise
+    # identical in VALUE (verified: max abs diff exactly 0.0), the
+    # inserted converts break XLA's einsum fusions and cost more than
+    # the extra MXU passes save.  Keep 'highest'.
+    corr_precision: str = "auto"
     # bf16 compute for encoders + update block (replaces the reference's
     # torch.cuda.amp autocast, raft.py:11-21,99,110,127); correlation
     # stays fp32 at the default corr_precision='highest' (reference
@@ -148,6 +153,12 @@ class RAFTConfig:
             return ("bfloat16" if self.compute_dtype == "bfloat16"
                     else "float32")
         return self.corr_dtype
+
+    @property
+    def resolved_corr_precision(self) -> str:
+        if self.corr_precision == "auto":
+            return "highest"   # measured fastest on v5e (see above)
+        return self.corr_precision
 
     @property
     def resolved_upsample_dtype(self) -> str:

@@ -110,13 +110,13 @@ class RefinementStep(nn.Module):
 
         if cfg.corr_impl == "allpairs":
             corr = corr_lookup(corr_state, coords1, cfg.corr_radius,
-                               cfg.corr_precision)
+                               cfg.resolved_corr_precision)
         elif cfg.corr_impl == "chunked":
             fmap1, f2_pyramid = corr_state
             corr = chunked_corr_lookup(fmap1, f2_pyramid, coords1,
                                        cfg.corr_radius,
                                        block_size=cfg.corr_block_size,
-                                       precision=cfg.corr_precision)
+                                       precision=cfg.resolved_corr_precision)
         elif cfg.corr_impl == "allpairs_pallas":
             from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup
 
@@ -295,10 +295,10 @@ class RAFT(nn.Module):
 
         if cfg.corr_impl == "allpairs":
             corr_state = build_corr_pyramid(fmap1, fmap2, cfg.corr_levels,
-                                            cfg.corr_precision)
+                                            cfg.resolved_corr_precision)
         elif cfg.corr_impl == "allpairs_pallas":
             corr_state = build_corr_pyramid_flat(
-                fmap1, fmap2, cfg.corr_levels, cfg.corr_precision,
+                fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
                 pad_q=cfg.lookup_block_q,
                 out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
         elif cfg.corr_impl in ("chunked", "pallas"):

@@ -72,7 +72,11 @@ def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array,
     corr = jnp.einsum("bnc,bmc->bnm", f1, f2,
                       precision=resolve_precision(precision),
                       preferred_element_type=jnp.float32)
-    corr = corr / jnp.sqrt(jnp.float32(C))
+    # Reciprocal-MULTIPLY, not divide: TPU divide is a multi-pass VPU op
+    # and XLA does not strength-reduce fp division by a constant; the
+    # divide over the full (HW)^2 volume profiled at ~3.5 ms/step
+    # (fwd+transpose) at the chairs bench shape.
+    corr = corr * (1.0 / float(C) ** 0.5)
     return corr.reshape(B, H * W, H, W)
 
 
@@ -83,7 +87,7 @@ def _avg_pool_2x2(x: jax.Array) -> jax.Array:
     H2, W2 = H // 2, W // 2
     x = x[:, :, : H2 * 2, : W2 * 2]
     x = x.reshape(B, N, H2, 2, W2, 2)
-    return x.mean(axis=(3, 5))
+    return x.sum(axis=(3, 5)) * 0.25   # sum*0.25: no divide pass
 
 
 def build_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array,
@@ -105,7 +109,7 @@ def _avg_pool_2x2_qminor(x: jax.Array) -> jax.Array:
     H2, W2 = H // 2, W // 2
     x = x[:, : H2 * 2, : W2 * 2, :]
     x = x.reshape(B, H2, 2, W2, 2, N)
-    return x.mean(axis=(2, 4))
+    return x.sum(axis=(2, 4)) * 0.25   # sum*0.25: no divide pass
 
 
 def build_corr_pyramid_flat(fmap1: jax.Array, fmap2: jax.Array,
@@ -134,7 +138,7 @@ def build_corr_pyramid_flat(fmap1: jax.Array, fmap2: jax.Array,
     corr = jnp.einsum("byxc,bqc->byxq", f2, f1,
                       precision=resolve_precision(precision),
                       preferred_element_type=jnp.float32)
-    corr = corr / jnp.sqrt(jnp.float32(C))
+    corr = corr * (1.0 / float(C) ** 0.5)   # mul, not divide (see above)
     # Pyramid math (pooling) stays fp32; only the STORED levels round to
     # ``out_dtype`` (XLA fuses the casts into the einsum/pool epilogues).
     pyramid = [corr.astype(out_dtype)]

@@ -45,6 +45,10 @@ def run_stage(data_root, workdir, corr_dtype, seed, steps, batch):
         "--iters", "8",
         "--val_freq", str(steps),
         "--seed", str(seed),
+        # Pin the impl that actually CONSUMES corr_dtype: 'auto' would
+        # resolve to 'allpairs' off-TPU (cli/train.py) and the two arms
+        # would silently train identical configurations.
+        "--corr_impl", "allpairs_pallas",
         "--corr_dtype", corr_dtype,
         "--data_root", data_root,
         "--chairs_split", osp.join(workdir, "chairs_split.txt"),
@@ -80,23 +84,32 @@ def main(argv=None):
             print(f"{dtype} seed {1000 + seed}: chairs EPE {epe}",
                   flush=True)
             epes.append(epe)
+            results["per_seed"][dtype] = epes
+            with open(args.out, "w") as f:  # incremental: a crash later
+                json.dump(results, f, indent=2)  # keeps finished seeds
         results["per_seed"][dtype] = epes
         clean = [e for e in epes if e is not None]
         results["arms"][dtype] = {
             "n": len(clean),
-            "mean": round(statistics.mean(clean), 4),
+            "mean": round(statistics.mean(clean), 4) if clean else None,
             "sd": round(statistics.stdev(clean), 4) if len(clean) > 1
             else None,
         }
     a, b = results["arms"]["bfloat16"], results["arms"]["float32"]
     # Welch-ish check: is the arm gap resolvable against seed noise?
+    # Guarded so a degenerate arm (n < 2, e.g. --seeds 1 or unparseable
+    # validator output) still writes the per-seed results it has.
     import math
 
-    se = math.sqrt((a["sd"] ** 2) / a["n"] + (b["sd"] ** 2) / b["n"])
-    results["mean_gap_bf16_minus_fp32"] = round(a["mean"] - b["mean"], 4)
-    results["gap_stderr"] = round(se, 4)
-    results["gap_in_stderr_units"] = round(
-        (a["mean"] - b["mean"]) / se, 2) if se else None
+    if a["sd"] is not None and b["sd"] is not None:
+        se = math.sqrt((a["sd"] ** 2) / a["n"] + (b["sd"] ** 2) / b["n"])
+        results["mean_gap_bf16_minus_fp32"] = round(
+            a["mean"] - b["mean"], 4)
+        results["gap_stderr"] = round(se, 4)
+        results["gap_in_stderr_units"] = round(
+            (a["mean"] - b["mean"]) / se, 2) if se else None
+    else:
+        results["gap"] = "undefined (an arm has n < 2)"
     with open(args.out, "w") as f:
         json.dump(results, f, indent=2)
     print(json.dumps(results, indent=2), flush=True)

@@ -84,9 +84,27 @@ def _degrade(rng, img):
     made the two passes tautologically equal)."""
     import cv2
 
-    blurred = cv2.GaussianBlur(img.astype(np.float32), (5, 5), 1.2)
-    noise = rng.normal(0.0, 6.0, img.shape).astype(np.float32)
-    return np.clip(blurred + noise, 0, 255).astype(np.uint8)
+    # Lesson from two failed attempts (r04): global blur/gamma/grain DO
+    # NOT raise EPE on rigid-translation scenes — blur is translation-
+    # equivariant, monotone intensity maps are normalized away by the
+    # instance-norm encoders, and constant flow lets the model regress
+    # the global shift from any surviving matches.  What actually makes
+    # the final pass harder is SPATIALLY LOCAL corruption, different per
+    # frame: a smooth random illumination field (breaks brightness
+    # constancy non-uniformly) and opaque occluder blobs (destroy local
+    # matches outright, like final-pass fog/effects).
+    out = cv2.GaussianBlur(img.astype(np.float32), (5, 5), 1.2)
+    h, w = out.shape[:2]
+    field = cv2.resize(rng.uniform(0.45, 1.55, (4, 5)).astype(np.float32),
+                       (w, h), interpolation=cv2.INTER_CUBIC)
+    out *= field[..., None]
+    for _ in range(6):   # occluders, independent per frame
+        cy, cx = rng.integers(0, h), rng.integers(0, w)
+        r = int(rng.integers(6, 14))
+        col = tuple(float(v) for v in rng.uniform(0, 255, 3))
+        cv2.circle(out, (int(cx), int(cy)), r, col, -1)
+    out += rng.normal(0.0, 8.0, out.shape).astype(np.float32)
+    return np.clip(out, 0, 255).astype(np.uint8)
 
 
 def _pair_piecewise(rng, max_shift=14, obj_shift=10):
