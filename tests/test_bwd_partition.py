@@ -1,0 +1,68 @@
+"""The fused/blocked partition heuristic of the on-demand backward
+(raft_tpu/ops/pallas_corr.py): pure-shape logic, no kernels — pins WHICH
+levels go blocked at the shapes the round-4 hardware runs certified
+(BENCH_BEYOND_HBM_r04.json), so a budget/estimate regression cannot
+silently put a 56 MB level back into the fused kernel's VMEM.
+"""
+
+import jax.numpy as jnp
+
+from raft_tpu.ops.pallas_corr import (_BWD_TILE_H, _FUSED_BWD_BUDGET,
+                                      _fused_bwd_est, _odm_levels,
+                                      _partition_bwd_levels)
+
+
+def _pyramid_shapes(H8, W8, C=256, levels=4):
+    shapes = []
+    h, w = H8, W8
+    for _ in range(levels):
+        shapes.append((1, h, w, C))
+        h, w = h // 2, w // 2
+    return shapes
+
+
+def _nonempty(shapes):
+    pyr = [jnp.zeros(s, jnp.float32) for s in shapes]
+    ne, _ = _odm_levels(pyr, 9)
+    return ne
+
+
+def _partition(nonempty, block_q=128, k=9):
+    blocked, fused = _partition_bwd_levels(nonempty, block_q, k)
+    return [lvl for lvl, _ in blocked], [lvl for lvl, _ in fused]
+
+
+def test_736x1280_stays_fully_fused():
+    """The round-3 capability (3.6 pairs/s measured) must keep its
+    fused-only backward — moving it to blocked kernels would re-stream
+    f2 for no VMEM reason."""
+    blocked, fused = _partition(_nonempty(_pyramid_shapes(92, 160)))
+    assert blocked == []
+    assert fused == [0, 1, 2, 3]
+
+
+def test_1088x1920_blocks_level0_only():
+    blocked, fused = _partition(_nonempty(_pyramid_shapes(136, 240)))
+    assert blocked == [0]
+    assert fused == [1, 2, 3]
+
+
+def test_1440x2560_blocks_level0_only():
+    blocked, fused = _partition(_nonempty(_pyramid_shapes(180, 320)))
+    assert blocked == [0]
+    assert fused == [1, 2, 3]
+
+
+def test_partition_terminates_even_on_absurd_shapes():
+    """8K-class: whatever the split, the loop must terminate with every
+    level somewhere and the fused remainder under budget."""
+    ne = _nonempty(_pyramid_shapes(544, 960))
+    blocked, fused = _partition(ne)
+    assert sorted(blocked + fused) == [0, 1, 2, 3]
+    if fused:
+        rem = [x for x in ne if x[0] in fused]
+        assert _fused_bwd_est(rem, 128, 9) <= _FUSED_BWD_BUDGET
+
+
+def test_tile_h_divides_padded_rows():
+    assert _BWD_TILE_H >= 1

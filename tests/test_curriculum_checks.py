@@ -1,0 +1,84 @@
+"""The toy curriculum's validator parsing + discriminative checks
+(scripts/curriculum_toy.py): these are the round-4 answer to "the toy
+validators could not fail" (VERDICT r3 weak #4), so they get their own
+unit coverage — a parser that silently returns {} on a diverged run
+would reopen the hole.
+"""
+
+import numpy as np
+
+from scripts.curriculum_toy import (_degrade, _discriminative_checks,
+                                    _pair_piecewise, _parse_validation)
+
+
+def test_parse_all_validator_formats():
+    out = """
+Validation Chairs EPE: 2.697
+Validation (clean) EPE: 0.523, 1px: 0.912, 3px: 1.000, 5px: 1.000
+Validation (final) EPE: 1.206, 1px: 0.474, 3px: 0.962, 5px: 0.995
+Validation KITTI: 4.123, 0.271
+"""
+    vals = _parse_validation(out)
+    assert vals == {"chairs_epe": 2.697, "sintel_clean_epe": 0.523,
+                    "sintel_final_epe": 1.206, "kitti_epe": 4.123,
+                    "kitti_f1": 0.271}
+
+
+def test_parse_nan_is_not_silent():
+    """A diverged run prints nan — it must PARSE (and then fail the
+    sanity check), not vanish from vals."""
+    vals = _parse_validation("Validation Chairs EPE: nan\n")
+    assert np.isnan(vals["chairs_epe"])
+    checks = _discriminative_checks("chairs", vals)
+    assert checks["epe_sane"] is False
+
+
+def test_missing_headline_fails():
+    """No parseable validator output is itself a failure."""
+    checks = _discriminative_checks("chairs", {})
+    assert checks["epe_sane"] is False
+
+
+def test_final_vs_clean_ordering_check():
+    good = _discriminative_checks(
+        "things", {"sintel_clean_epe": 0.5, "sintel_final_epe": 1.2})
+    assert good["final_epe_gt_clean"] is True and good["epe_sane"] is True
+    bad = _discriminative_checks(
+        "things", {"sintel_clean_epe": 0.52, "sintel_final_epe": 0.51})
+    assert bad["final_epe_gt_clean"] is False
+
+
+def test_kitti_f1_positive_check():
+    assert _discriminative_checks(
+        "kitti", {"kitti_epe": 1.0, "kitti_f1": 0.0}
+    )["kitti_f1_positive"] is False
+    assert _discriminative_checks(
+        "kitti", {"kitti_epe": 1.0, "kitti_f1": 0.05}
+    )["kitti_f1_positive"] is True
+
+
+def test_degrade_is_local_and_strong():
+    """The final-pass degradation must change pixels NON-uniformly (a
+    global photometric map would be normalized away by the encoders and
+    measured to have no EPE effect — the round-4 lesson)."""
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (128, 160, 3)).astype(np.uint8)
+    d1 = _degrade(rng, img).astype(np.float32)
+    d2 = _degrade(rng, img).astype(np.float32)
+    # strong: mean change well above noise floor
+    assert np.abs(d1 - img.astype(np.float32)).mean() > 5.0
+    # independent per call (per frame)
+    assert np.abs(d1 - d2).mean() > 3.0
+    # local: per-region gain varies (illumination field + occluders)
+    g1 = d1[:32, :32].mean() / max(img[:32, :32].mean(), 1)
+    g2 = d1[-32:, -32:].mean() / max(img[-32:, -32:].mean(), 1)
+    assert abs(g1 - g2) > 0.05
+
+
+def test_piecewise_pair_has_motion_discontinuity():
+    rng = np.random.default_rng(1)
+    _, _, flow = _pair_piecewise(rng)
+    mags = np.linalg.norm(flow, axis=-1)
+    # at least two distinct motions and genuinely large displacement
+    assert len(np.unique(flow[..., 0].round(0))) >= 2
+    assert mags.max() >= 5.0
