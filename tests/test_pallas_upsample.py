@@ -25,7 +25,12 @@ def _xla_sums(flow, mask, gt128, vm64):
     def fsum(x):
         return jnp.sum(x, axis=(1, 2, 3, 4), dtype=jnp.float32)
 
-    epe = jnp.sqrt(dx * dx + dy * dy)
+    # Metric lanes are non-differentiable by contract; stop_gradient
+    # mirrors the production in-scan loss (models/raft.py, the
+    # UpsampleLossStep metric chain) — without it the sqrt's VJP at
+    # exactly-zero residuals injects 0*inf = NaN even under zero
+    # cotangents.
+    epe = jax.lax.stop_gradient(jnp.sqrt(dx * dx + dy * dy))
     return jnp.stack([
         fsum(vm * (jnp.abs(dx) + jnp.abs(dy))),
         fsum(vm * epe),
@@ -55,6 +60,36 @@ def test_fwd_matches_xla():
     got = jnp.sum(got.reshape(g, B, 5), axis=1)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-3)
+
+
+def test_grads_match_xla_at_exact_zero_residuals():
+    """Subgradient-at-zero contract (ADVICE r3 #1): with ground truth
+    CONSTRUCTED so some residuals are exactly 0, the kernel's L1
+    derivative must match jnp.abs's VJP convention (+1 at zero), not
+    jnp.sign's (0 at zero).  Zero flow + softmax-uniform masks give
+    upsampled output exactly 0 wherever gt is 0."""
+    flow = jnp.zeros((gB, H, W, 2), jnp.float32)
+    mask = jnp.zeros((gB, H, W, 576), jnp.float32)   # uniform softmax
+    rng = np.random.default_rng(3)
+    gt = jnp.asarray(
+        (rng.uniform(size=(B, 8 * H, 8 * W, 2)) > 0.5) * 2.0, jnp.float32)
+    vm = np.ones((B, 8 * H, 8 * W), np.float32)
+    gt128 = space_to_depth_flow(gt)
+    vm64 = space_to_depth_flow(jnp.asarray(vm)[..., None])
+
+    def loss_pallas(flow, mask):
+        s = pallas_upsample_loss_sums(flow, mask, gt128, vm64,
+                                      interpret=True)
+        return jnp.sum(s[:, 0])
+
+    def loss_xla(flow, mask):
+        return jnp.sum(_xla_sums(flow, mask, gt128, vm64)[:, 0])
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1))(flow, mask)
+    gx = jax.grad(loss_xla, argnums=(0, 1))(flow, mask)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
 
 
 def test_grads_match_xla():
