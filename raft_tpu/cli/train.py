@@ -74,6 +74,13 @@ def parse_args(argv=None):
                         "backward. 0 is faster when its residuals fit "
                         "(+11%% at the things crop batch 8/chip, v5e "
                         "round 3); 1 (default) is the safe choice")
+    p.add_argument("--scan_unroll", type=int, default=None,
+                   help="refinement-scan unroll factor (default: the "
+                        "config's tuned 12). Use 1 at beyond-HBM "
+                        "shapes — each iteration is O(100ms) of device "
+                        "work so unroll buys nothing and the 12x graph "
+                        "can crash the compiler (round-4 lesson) — or "
+                        "on CPU where the unrolled compile is minutes")
     p.add_argument("--corr_dtype", default="auto",
                    choices=["auto", "float32", "bfloat16"],
                    help="materialized corr-pyramid storage dtype; 'auto' "
@@ -168,7 +175,9 @@ def main(argv=None):
                    remat=args.remat != "none",
                    remat_policy=args.remat if args.remat != "none"
                    else "save_corr",
-                   remat_upsample=bool(args.remat_upsample))
+                   remat_upsample=bool(args.remat_upsample),
+                   **({"scan_unroll": args.scan_unroll}
+                      if args.scan_unroll is not None else {}))
     num_hosts = jax.process_count()
     num_devices = jax.device_count()
     batch_size, lr = resolve_batch(args.batch_size, args.batch_per_chip,

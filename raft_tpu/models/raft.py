@@ -294,8 +294,11 @@ class RAFT(nn.Module):
         fmap2 = fmaps[B:].astype(jnp.float32)
 
         if cfg.corr_impl == "allpairs":
-            corr_state = build_corr_pyramid(fmap1, fmap2, cfg.corr_levels,
-                                            cfg.resolved_corr_precision)
+            # corr_dtype (storage) applies here too: the XLA lookup
+            # re-accumulates fp32 in _sample_windows regardless.
+            corr_state = build_corr_pyramid(
+                fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
+                out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
         elif cfg.corr_impl == "allpairs_pallas":
             corr_state = build_corr_pyramid_flat(
                 fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,

@@ -92,13 +92,19 @@ def _avg_pool_2x2(x: jax.Array) -> jax.Array:
 
 def build_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array,
                        num_levels: int = 4,
-                       precision="highest") -> List[jax.Array]:
-    """Materialized pyramid: level l is ``(B, H1*W1, H/2^l, W/2^l)``."""
+                       precision="highest",
+                       out_dtype=jnp.float32) -> List[jax.Array]:
+    """Materialized pyramid: level l is ``(B, H1*W1, H/2^l, W/2^l)``.
+
+    ``out_dtype``: STORAGE dtype of the levels (``RAFTConfig.corr_dtype``
+    semantics, same as :func:`build_corr_pyramid_flat` — pooling math
+    stays fp32 and the lookup re-accumulates fp32; only stored values
+    round)."""
     corr = all_pairs_correlation(fmap1, fmap2, precision)
-    pyramid = [corr]
+    pyramid = [corr.astype(out_dtype)]
     for _ in range(num_levels - 1):
         corr = _avg_pool_2x2(corr)
-        pyramid.append(corr)
+        pyramid.append(corr.astype(out_dtype))
     return pyramid
 
 

@@ -33,7 +33,8 @@ from scripts.curriculum_toy import (CROP, _parse_validation,  # noqa: E402
                                     build_corpora)
 
 
-def run_stage(data_root, workdir, corr_dtype, seed, steps, batch):
+def run_stage(data_root, workdir, corr_dtype, seed, steps, batch,
+              impl="allpairs_pallas"):
     from raft_tpu.cli import train as train_cli
 
     name = f"ab-{corr_dtype}-{seed}"
@@ -45,10 +46,16 @@ def run_stage(data_root, workdir, corr_dtype, seed, steps, batch):
         "--iters", "8",
         "--val_freq", str(steps),
         "--seed", str(seed),
-        # Pin the impl that actually CONSUMES corr_dtype: 'auto' would
-        # resolve to 'allpairs' off-TPU (cli/train.py) and the two arms
-        # would silently train identical configurations.
-        "--corr_impl", "allpairs_pallas",
+        # Pin an impl that actually CONSUMES corr_dtype ('auto' could
+        # resolve differently per backend and silently equalize the
+        # arms): allpairs_pallas on TPU; 'allpairs' honors corr_dtype
+        # identically (fp32 re-accumulating lookup over stored levels)
+        # and avoids the Pallas interpreter on CPU.
+        "--corr_impl", impl,
+        # unroll=1: identical math, and the per-run jit recompile (16
+        # fresh step functions in one process) drops from ~10 min of
+        # unrolled-graph XLA CPU compile to seconds.
+        "--scan_unroll", "1",
         "--corr_dtype", corr_dtype,
         "--data_root", data_root,
         "--chairs_split", osp.join(workdir, "chairs_split.txt"),
@@ -67,28 +74,41 @@ def main(argv=None):
     ap.add_argument("--seeds", type=int, default=8)
     ap.add_argument("--steps", type=int, default=300)
     ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--impl", default=None,
+                    choices=["allpairs_pallas", "allpairs"],
+                    help="default: allpairs_pallas on TPU, allpairs "
+                         "elsewhere (the Pallas kernels would run in "
+                         "the very slow interpreter off-TPU; both impls "
+                         "honor corr_dtype identically)")
     ap.add_argument("--out", default="AB_CORR_DTYPE.json")
     args = ap.parse_args(argv)
 
+    if args.impl is None:
+        import jax
+
+        args.impl = ("allpairs_pallas"
+                     if jax.default_backend() == "tpu" else "allpairs")
     workdir = tempfile.mkdtemp(prefix="raft_ab_dtype_")
     data_root = build_corpora(workdir)
     print(f"synthetic chairs in {data_root}", flush=True)
 
     results = {"steps": args.steps, "batch": args.batch,
-               "arms": {}, "per_seed": {}}
-    for dtype in ("bfloat16", "float32"):
-        epes = []
-        for seed in range(args.seeds):
+               "impl": args.impl, "arms": {},
+               "per_seed": {"bfloat16": [], "float32": []}}
+    # Seed-major, arms INNER: if the run is cut short, the finished
+    # seeds still form a paired comparison (arm-major would leave one
+    # arm empty).
+    for seed in range(args.seeds):
+        for dtype in ("bfloat16", "float32"):
             epe = run_stage(data_root, workdir, dtype, 1000 + seed,
-                            args.steps, args.batch)
+                            args.steps, args.batch, args.impl)
             print(f"{dtype} seed {1000 + seed}: chairs EPE {epe}",
                   flush=True)
-            epes.append(epe)
-            results["per_seed"][dtype] = epes
+            results["per_seed"][dtype].append(epe)
             with open(args.out, "w") as f:  # incremental: a crash later
                 json.dump(results, f, indent=2)  # keeps finished seeds
-        results["per_seed"][dtype] = epes
-        clean = [e for e in epes if e is not None]
+    for dtype in ("bfloat16", "float32"):
+        clean = [e for e in results["per_seed"][dtype] if e is not None]
         results["arms"][dtype] = {
             "n": len(clean),
             "mean": round(statistics.mean(clean), 4) if clean else None,

@@ -2,20 +2,20 @@
 # Round-4 TPU backlog: everything queued behind the mid-round tunnel
 # death, in priority order.  Run when `python -c "import jax;
 # jax.devices()"` responds again.  Each step is independently resumable.
-set -x
+set -x -o pipefail
 cd "$(dirname "$0")/.."
 
 # 1. Full 4-stage toy curriculum with the (verified) discriminative
 #    validators -> CURRICULUM_TOY_r04.json
 rm -rf /tmp/curr_r04
 python scripts/curriculum_toy.py /tmp/curr_r04 \
-    --out CURRICULUM_TOY_r04.json 2>&1 | tail -20
+    --out CURRICULUM_TOY_r04.json 2>&1 | tee /tmp/curr_r04.log | tail -20
 
 # 2. 8-seed bf16-vs-fp32 corr-storage A/B -> AB_CORR_DTYPE.json
-python scripts/ab_corr_dtype.py --out AB_CORR_DTYPE.json 2>&1 | tail -25
+python scripts/ab_corr_dtype.py --out AB_CORR_DTYPE.json 2>&1 | tee /tmp/ab_r04.log | tail -25
 
 # 3. Eval-forward refresh with the new regression pin
-BENCH_MODE=eval python bench.py 2>&1 | tail -2
+BENCH_MODE=eval python bench.py 2>&1 | tee /tmp/bench_eval_r04.log | tail -2
 
 # 4. Final headline bench
-python bench.py 2>&1 | tail -2
+python bench.py 2>&1 | tee /tmp/bench_r04.log | tail -2

@@ -97,3 +97,20 @@ def test_chunked_is_differentiable():
     assert np.isfinite(np.asarray(g1)).all()
     assert np.isfinite(np.asarray(g2)).all()
     assert np.abs(np.asarray(g2)).sum() > 0  # gradient flows into fmap2
+
+
+def test_pyramid_bf16_storage_close_to_fp32():
+    """corr_dtype applies to the XLA allpairs pyramid too (round 4):
+    bf16 STORAGE with the fp32 re-accumulating lookup tracks the fp32
+    pyramid within bf16 rounding."""
+    rng = np.random.default_rng(11)
+    f1 = jnp.asarray(rng.standard_normal((1, 16, 24, 64)), jnp.float32)
+    f2 = jnp.asarray(rng.standard_normal((1, 16, 24, 64)), jnp.float32)
+    coords = coords_grid(1, 16, 24) + jnp.asarray(
+        rng.uniform(-2, 2, (1, 16, 24, 2)), jnp.float32)
+    want = np.asarray(
+        corr_lookup(build_corr_pyramid(f1, f2, 4), coords, 4))
+    pyr16 = build_corr_pyramid(f1, f2, 4, out_dtype=jnp.bfloat16)
+    assert all(p.dtype == jnp.bfloat16 for p in pyr16)
+    got = np.asarray(corr_lookup(pyr16, coords, 4))
+    np.testing.assert_allclose(got, want, rtol=0.02, atol=0.05)
