@@ -15,6 +15,24 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+_warned_fallback = set()
+
+
+def _warn_pallas_fallback(requested: str, substituted: str) -> None:
+    """One warning per (requested, substituted) pair per process: the
+    silent alternative is a user discovering the Pallas interpreter's
+    ~1000x slowdown by watching a hung process."""
+    import warnings
+
+    key = (requested, substituted)
+    if key not in _warned_fallback:
+        _warned_fallback.add(key)
+        warnings.warn(
+            f"{requested} requires a TPU backend; dispatching the "
+            f"equivalent XLA implementation {substituted!r} instead "
+            "(set pallas_offtpu='interpret' to force the Pallas "
+            "interpreter)", stacklevel=3)
+
 
 @dataclasses.dataclass(frozen=True)
 class RAFTConfig:
@@ -134,6 +152,18 @@ class RAFTConfig:
     # "upsampler" scopes).  Eval and the stacked-flows API always use
     # the two-scan form.
     fuse_upsample_in_scan: bool = False
+    # Off-TPU handling of the Pallas code paths (corr_impl
+    # 'allpairs_pallas'/'pallas', upsample_loss_kernel='pallas').
+    # 'fallback' (default): dispatch the equivalent XLA implementation
+    # instead — allpairs_pallas -> allpairs (same materialized pyramid,
+    # einsum lookup), pallas -> chunked (same O(HW) blockwise on-demand
+    # math), pallas upsample kernel -> xla — because off-TPU the Pallas
+    # kernels can only run in the interpreter, which is orders of
+    # magnitude slower than the XLA paths.  'interpret': keep the Pallas
+    # kernels in interpreter mode anyway (the CPU-mesh tests and the
+    # driver dryrun use this to exercise the shipped kernel path without
+    # a TPU).  Inert on TPU.
+    pallas_offtpu: str = "fallback"
 
     @classmethod
     def full(cls, **kw) -> "RAFTConfig":
@@ -159,6 +189,37 @@ class RAFTConfig:
         if self.corr_precision == "auto":
             return "highest"   # measured fastest on v5e (see above)
         return self.corr_precision
+
+    def _pallas_dispatchable(self) -> bool:
+        if self.pallas_offtpu == "interpret":
+            return True
+        if self.pallas_offtpu != "fallback":
+            raise ValueError(f"unknown pallas_offtpu: "
+                             f"{self.pallas_offtpu!r} (expected "
+                             "'fallback' or 'interpret')")
+        import jax
+
+        return jax.default_backend() == "tpu"
+
+    @property
+    def resolved_corr_impl(self) -> str:
+        """``corr_impl`` with the off-TPU Pallas fallback applied."""
+        if (self.corr_impl in ("allpairs_pallas", "pallas")
+                and not self._pallas_dispatchable()):
+            sub = {"allpairs_pallas": "allpairs", "pallas": "chunked"}[
+                self.corr_impl]
+            _warn_pallas_fallback(f"corr_impl={self.corr_impl!r}", sub)
+            return sub
+        return self.corr_impl
+
+    @property
+    def resolved_upsample_loss_kernel(self) -> str:
+        """``upsample_loss_kernel`` with the off-TPU Pallas fallback."""
+        if (self.upsample_loss_kernel == "pallas"
+                and not self._pallas_dispatchable()):
+            _warn_pallas_fallback("upsample_loss_kernel='pallas'", "xla")
+            return "xla"
+        return self.upsample_loss_kernel
 
     @property
     def resolved_upsample_dtype(self) -> str:

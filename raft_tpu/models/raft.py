@@ -108,16 +108,17 @@ class RefinementStep(nn.Module):
 
         coords1 = jax.lax.stop_gradient(coords1)
 
-        if cfg.corr_impl == "allpairs":
+        corr_impl = cfg.resolved_corr_impl
+        if corr_impl == "allpairs":
             corr = corr_lookup(corr_state, coords1, cfg.corr_radius,
                                cfg.resolved_corr_precision)
-        elif cfg.corr_impl == "chunked":
+        elif corr_impl == "chunked":
             fmap1, f2_pyramid = corr_state
             corr = chunked_corr_lookup(fmap1, f2_pyramid, coords1,
                                        cfg.corr_radius,
                                        block_size=cfg.corr_block_size,
                                        precision=cfg.resolved_corr_precision)
-        elif cfg.corr_impl == "allpairs_pallas":
+        elif corr_impl == "allpairs_pallas":
             from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup
 
             # Taps are consumed in cfg.dtype (the astype below) — emit
@@ -127,7 +128,7 @@ class RefinementStep(nn.Module):
             corr = pallas_pyramid_lookup(corr_state, coords1,
                                          cfg.corr_radius,
                                          cfg.lookup_block_q, None, dt)
-        elif cfg.corr_impl == "pallas":
+        elif corr_impl == "pallas":
             from raft_tpu.ops.pallas_corr import pallas_corr_lookup
 
             fmap1, f2_pyramid = corr_state
@@ -205,13 +206,13 @@ class UpsampleLossStep(nn.Module):
         # Tagged so remat_policy='save_corr_upsample' can pin the logits
         # (no-op under the other policies / outside remat).
         mask = checkpoint_name(mask, "mask")
-        if cfg.upsample_loss_kernel == "pallas":
+        if cfg.resolved_upsample_loss_kernel == "pallas":
             from raft_tpu.ops.pallas_upsample import \
                 pallas_upsample_loss_sums
 
             sums = pallas_upsample_loss_sums(flow, mask, gt128, vmask64)
             return carry, jnp.sum(sums.reshape(g, B, 5), axis=1)
-        if cfg.upsample_loss_kernel != "xla":
+        if cfg.resolved_upsample_loss_kernel != "xla":
             raise ValueError(
                 f"unknown upsample_loss_kernel: "
                 f"{cfg.upsample_loss_kernel!r} (expected 'xla' or "
@@ -293,18 +294,19 @@ class RAFT(nn.Module):
         fmap1 = fmaps[:B].astype(jnp.float32)
         fmap2 = fmaps[B:].astype(jnp.float32)
 
-        if cfg.corr_impl == "allpairs":
+        corr_impl = cfg.resolved_corr_impl
+        if corr_impl == "allpairs":
             # corr_dtype (storage) applies here too: the XLA lookup
             # re-accumulates fp32 in _sample_windows regardless.
             corr_state = build_corr_pyramid(
                 fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
                 out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
-        elif cfg.corr_impl == "allpairs_pallas":
+        elif corr_impl == "allpairs_pallas":
             corr_state = build_corr_pyramid_flat(
                 fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
                 pad_q=cfg.lookup_block_q,
                 out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
-        elif cfg.corr_impl in ("chunked", "pallas"):
+        elif corr_impl in ("chunked", "pallas"):
             corr_state = (fmap1, pool_fmap_pyramid(fmap2, cfg.corr_levels))
         else:
             raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")

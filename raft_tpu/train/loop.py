@@ -151,7 +151,7 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
         for batch in batches:
             if step >= cfg.num_steps:
                 break
-            if _PREEMPT.is_set() or (
+            if (jax.process_count() == 1 and _PREEMPT.is_set()) or (
                     jax.process_count() > 1
                     and _reached_preemption_sync(step)):
                 raise SystemExit(143)  # step boundary; state is consistent
@@ -168,11 +168,15 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
 
             # Second preemption check before the (potentially minutes-
             # long) save+validate block, so a SIGTERM during the step
-            # exits here instead of after full validation.  Caveat: a
-            # SIGTERM while the data loader itself is blocked in
-            # ``next(batches)`` is only observed once the loader yields —
-            # the flag cannot interrupt host-side IO.
-            if _PREEMPT.is_set():
+            # exits here instead of after full validation.  Single-host
+            # only: the per-host flag has no cross-host agreement, so an
+            # early exit here on one host would strand the others in the
+            # collective save/validate block — multi-host preemption
+            # exits solely through the agreed-step sync at the top of
+            # the loop.  Caveat: a SIGTERM while the data loader itself
+            # is blocked in ``next(batches)`` is only observed once the
+            # loader yields — the flag cannot interrupt host-side IO.
+            if jax.process_count() == 1 and _PREEMPT.is_set():
                 raise SystemExit(143)
 
             if step % cfg.val_freq == 0:

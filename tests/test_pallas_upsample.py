@@ -125,7 +125,8 @@ def test_model_path_matches_xla_kernel_choice():
     gt = jnp.asarray(rng.standard_normal((b, h, w, 2)), jnp.float32)
     valid = jnp.ones((b, h, w), jnp.float32)
     cfg_x = RAFTConfig.full()
-    cfg_p = cfg_x.replace(upsample_loss_kernel="pallas")
+    cfg_p = cfg_x.replace(upsample_loss_kernel="pallas",
+                          pallas_offtpu="interpret")
     mx, mp = RAFT(cfg_x), RAFT(cfg_p)
     k = jax.random.PRNGKey(0)
     v = mx.init({"params": k, "dropout": k}, img1, img2, iters=2,

@@ -42,7 +42,8 @@ def _batch(rng, h=H, w=W, b=B):
 def test_spatial_sharded_step_matches_dp(corr_impl):
     if jax.device_count() < 8:
         pytest.skip("needs 8 virtual devices")
-    model_cfg = RAFTConfig.full(corr_impl=corr_impl)
+    model_cfg = RAFTConfig.full(corr_impl=corr_impl,
+                                pallas_offtpu="interpret")
     cfg = TrainConfig(num_steps=10, batch_size=B, image_size=(H, W),
                       iters=2)
     model = RAFT(model_cfg)
@@ -81,7 +82,8 @@ def test_flagship_bf16_spatial_step_wide_aspect(corr_impl):
         pytest.skip("needs 8 virtual devices")
     h, w = 96, 256
     model_cfg = RAFTConfig.full(compute_dtype="bfloat16",
-                                corr_impl=corr_impl)
+                                corr_impl=corr_impl,
+                                pallas_offtpu="interpret")
     cfg = TrainConfig(num_steps=10, batch_size=B, image_size=(h, w),
                       iters=2)
     assert cfg.fused_loss
