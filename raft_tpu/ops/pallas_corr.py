@@ -82,6 +82,7 @@ contraction sparsifies by roughly Hl / (window + flow extent).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Sequence
 
 import jax
@@ -286,7 +287,15 @@ _BWD_TILE_H = 8          # f2 rows per streamed tile
 _BWD_BLOCK_Q = 512       # query block of the blocked kernels (bigger than
                          # the fused 128: f2 re-streams once per query
                          # block in the df1 kernel, so fewer blocks =
-                         # proportionally less DMA)
+                         # proportionally less DMA.  The block fetch is
+                         # unconditional — _tile_overlaps skips COMPUTE,
+                         # not DMA — so at 1440x2560 level 0 (bf16 f2 =
+                         # 29.5 MB, 113 blocks at 512) the df1 kernel
+                         # moves ~3.3 GB/call; 1024 halves that for
+                         # ~24 MB more VMEM working set (drows + b_j
+                         # doubling), still under the 100 MB limit.
+                         # Override per-run with RAFT_ODM_BWD_BLOCK_Q to
+                         # sweep on hardware (scripts/tpu_backlog_r05).
 
 
 def _fused_bwd_est(nonempty, block_q, k):
@@ -1042,7 +1051,7 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
             df2_by_level[lvl] = out
 
     if blocked:
-        bq2 = _BWD_BLOCK_Q
+        bq2 = int(os.environ.get("RAFT_ODM_BWD_BLOCK_Q", _BWD_BLOCK_Q))
         f1p2, cp2, _ = _pad_queries(f1, c, bq2)
         Npad2 = f1p2.shape[1]
         gp2 = g_base

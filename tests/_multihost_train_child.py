@@ -7,9 +7,11 @@ Each process owns 2 virtual CPU devices (4 global).  The child runs the
 REAL ``train()`` loop three times against synthetic data:
 
   A. straight:  6 steps start-to-finish                 -> params_A
-  B. preempted: the batch stream raises KeyboardInterrupt after step 3
-     (mid-epoch, past the step-2 periodic checkpoint) — the loop's
-     emergency save must flush step 3;
+  B. preempted: the batch stream raises SystemExit(143) after step 3 on
+     both hosts at the same boundary (the agreed-step exit shape; the
+     per-host _PREEMPT flag is single-host-only) — mid-epoch, past the
+     step-2 periodic checkpoint; the loop's emergency save must flush
+     step 3;
   C. resumed:   same checkpoint dir, runs 3 -> 6        -> params_C
 
 and asserts ``params_A == params_C`` bit-level.  Equality proves ALL
@@ -64,23 +66,25 @@ class SynthDataset:
 
 
 class PreemptingLoader:
-    """Delegates to a real ShardedLoader but requests preemption after
-    ``stop_after`` batches — the cooperative SIGTERM path the CLI wires
-    (cli/train.py signal handler -> loop.request_preemption)."""
+    """Delegates to a real ShardedLoader but raises ``SystemExit(143)``
+    after ``stop_after`` batches — on EVERY host at the SAME batch
+    boundary, standing in for the coordination-service agreed-step exit
+    (``reached_preemption_sync_point``).  The per-host ``_PREEMPT`` flag
+    is deliberately NOT used here: it is single-host-only by design
+    (``train()`` gates it on ``process_count() == 1`` so one host's flag
+    can never strand the others in a collective)."""
 
     def __init__(self, loader, stop_after):
         self._loader = loader
         self._stop_after = stop_after
 
     def batches_from_step(self, step):
-        from raft_tpu.train import loop
-
         inner = self._loader.batches_from_step(step)
 
         def gen():
             for n, batch in enumerate(inner):
                 if n == self._stop_after:
-                    loop.request_preemption()  # checked at step boundary
+                    raise SystemExit(143)  # agreed step on all hosts
                 yield batch
 
         return gen()

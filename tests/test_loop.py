@@ -76,3 +76,36 @@ def test_checkpoint_manager_roundtrip(tmp_path):
     p = mgr.restore_params(state)
     assert "params" in p and "batch_stats" in p
     mgr.close()
+
+
+def test_single_host_request_preemption_saves_and_resumes(tmp_path):
+    """The cooperative single-host SIGTERM path (the one the CLI wires):
+    request_preemption() mid-stream must exit SystemExit(143) at the
+    next step boundary, flush the emergency checkpoint, and a fresh
+    train() must resume from it.  This is the only coverage of the
+    _PREEMPT flag path — the multihost child deliberately uses the
+    agreed-step exit instead (the flag is gated to process_count()==1)."""
+    from raft_tpu.train import loop as loop_mod
+
+    mcfg = RAFTConfig.small_model(corr_levels=2, corr_radius=2)
+    tcfg = TrainConfig(name="p", lr=1e-4, num_steps=6, batch_size=8,
+                       image_size=(32, 32), iters=2, val_freq=4,
+                       log_freq=2, ckpt_dir=str(tmp_path))
+
+    def preempting_batches():
+        for n, b in enumerate(_batches(10, tcfg)):
+            if n == 3:  # past the step-boundary check for step 3
+                loop_mod.request_preemption()
+            yield b
+
+    with pytest.raises(SystemExit) as ex:
+        train(mcfg, tcfg, preempting_batches())
+    assert ex.value.code == 143
+    # Emergency save flushed the last completed step (3: flag was set
+    # while fetching batch 3, observed at that step's boundary check).
+    mgr = CheckpointManager(str(tmp_path / "p"))
+    assert mgr.latest_step() == 3
+    mgr.close()
+
+    state = train(mcfg, tcfg, _batches(10, tcfg))
+    assert int(state.step) == 6
