@@ -164,8 +164,13 @@ def load_hbm_limit(default_gb=None):
     root = osp.dirname(osp.dirname(osp.dirname(osp.abspath(__file__))))
     p = osp.join(root, "HBM_LIMIT.json")
     if osp.exists(p):
-        with open(p) as f:
-            rec = json.load(f)
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            # e.g. truncated by a killed probe — fall back, don't crash
+            # the (expensive) run that merely wanted the limit.
+            return default_gb, "corrupt HBM_LIMIT.json"
         v = rec.get("hbm_limit_gb")
         if isinstance(v, (int, float)) and v >= 1.0:
             return float(v), rec.get("source", "HBM_LIMIT.json")

@@ -95,7 +95,11 @@ def make_loader():
                          num_hosts=nproc, host_id=pid, num_workers=2)
 
 
-model_cfg = RAFTConfig.small_model()
+# Tiny pyramid: what this test pins (distributed batch assembly,
+# agreed-step preemption, checkpoint continuity) is independent of the
+# correlation shape, and the full small-model graph dominates the
+# 2-process XLA-CPU compile time on the 1-core container.
+model_cfg = RAFTConfig.small_model(corr_levels=2, corr_radius=2)
 B_global = 2 * nproc
 
 
