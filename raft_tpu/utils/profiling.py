@@ -154,24 +154,45 @@ def measure_hbm_limit(max_gb: float = 64.0, chunk_mb: int = 256) -> dict:
             "source": f"allocation probe ({chunk_mb} MiB chunks)"}
 
 
-def load_hbm_limit(default_gb=None):
+def load_hbm_limit(default_gb=None, path=None):
     """The measured device-memory limit from ``HBM_LIMIT.json`` at the
     repo root (written by ``scripts/hbm_limit.py``), else
     ``(default_gb, reason)``.  One loader so the beyond-HBM scripts
-    can't drift in how they validate the artifact."""
+    can't drift in how they validate the artifact.  ``path`` overrides
+    the artifact location (tests)."""
     import json
 
-    root = osp.dirname(osp.dirname(osp.dirname(osp.abspath(__file__))))
-    p = osp.join(root, "HBM_LIMIT.json")
-    if osp.exists(p):
+    if path is None:
+        root = osp.dirname(osp.dirname(osp.dirname(osp.abspath(__file__))))
+        path = osp.join(root, "HBM_LIMIT.json")
+    if osp.exists(path):
         try:
-            with open(p) as f:
+            with open(path) as f:
                 rec = json.load(f)
         except (OSError, ValueError):
             # e.g. truncated by a killed probe — fall back, don't crash
             # the (expensive) run that merely wanted the limit.
             return default_gb, "corrupt HBM_LIMIT.json"
+        if not isinstance(rec, dict):
+            return default_gb, "corrupt HBM_LIMIT.json"
         v = rec.get("hbm_limit_gb")
         if isinstance(v, (int, float)) and v >= 1.0:
             return float(v), rec.get("source", "HBM_LIMIT.json")
     return default_gb, "no (valid) HBM_LIMIT.json"
+
+
+def enable_persistent_compile_cache() -> str:
+    """Turn on JAX's persistent XLA compilation cache at one shared
+    location.  Multi-run harnesses (the corr-dtype A/B, the toy
+    curriculum) build a fresh jit closure per stage, so without this
+    every stage recompiles programs an earlier stage already built —
+    ~40 min/program on the 1-core CPU fallback, ~20-40 s each on TPU.
+    Returns the cache directory."""
+    import tempfile
+
+    import jax
+
+    cache_dir = osp.join(tempfile.gettempdir(), "raft_jaxcache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return cache_dir

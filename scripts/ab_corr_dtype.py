@@ -128,14 +128,12 @@ def main(argv=None):
 
     import jax
 
-    # Persistent XLA compilation cache: every run_stage builds a fresh
-    # jit closure, so without this EVERY run recompiles the train+eval
-    # programs (~40 min/run on the 1-core CPU fallback — only 2 distinct
-    # programs per arm exist across all seeds).
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        osp.join(tempfile.gettempdir(), "raft_ab_jaxcache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    from raft_tpu.utils.profiling import enable_persistent_compile_cache
+
+    # Only 2 distinct programs per arm exist across all seeds; without
+    # the cache every run_stage recompiles them (~40 min/run on the
+    # 1-core CPU fallback).
+    enable_persistent_compile_cache()
 
     if args.impl is None:
         args.impl = ("allpairs_pallas"

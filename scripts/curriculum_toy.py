@@ -294,6 +294,14 @@ def main(argv=None):
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="raft_curriculum_")
     os.makedirs(workdir, exist_ok=True)
+
+    from raft_tpu.utils.profiling import enable_persistent_compile_cache
+
+    # The four stages (and validators) each build fresh jit closures;
+    # the shared persistent cache keeps later stages (and the A/B
+    # harness, which drives the same programs) from recompiling them.
+    enable_persistent_compile_cache()
+
     data_root = build_corpora(workdir)
     print(f"synthetic corpora in {data_root}", flush=True)
 

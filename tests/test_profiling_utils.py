@@ -1,0 +1,34 @@
+"""Unit tests for the HBM-limit artifact loader (no device work)."""
+
+import json
+
+from raft_tpu.utils.profiling import load_hbm_limit
+
+
+def test_load_hbm_limit_valid(tmp_path):
+    p = tmp_path / "HBM_LIMIT.json"
+    p.write_text(json.dumps(
+        {"hbm_limit_gb": 15.48, "source": "allocation probe"}))
+    assert load_hbm_limit(16.0, path=str(p)) == (15.48, "allocation probe")
+
+
+def test_load_hbm_limit_missing(tmp_path):
+    limit, src = load_hbm_limit(16.0, path=str(tmp_path / "nope.json"))
+    assert limit == 16.0 and "no (valid)" in src
+
+
+def test_load_hbm_limit_corrupt_and_degenerate(tmp_path):
+    p = tmp_path / "HBM_LIMIT.json"
+    p.write_text('{"hbm_limit_gb": 15.')           # truncated write
+    assert load_hbm_limit(16.0, path=str(p)) \
+        == (16.0, "corrupt HBM_LIMIT.json")
+    p.write_text("[15.48]")                        # valid JSON, not a dict
+    assert load_hbm_limit(16.0, path=str(p)) \
+        == (16.0, "corrupt HBM_LIMIT.json")
+    # "unavailable" marker (probe refused) is not a number -> fallback.
+    p.write_text(json.dumps({"hbm_limit_gb": "unavailable"}))
+    limit, _ = load_hbm_limit(None, path=str(p))
+    assert limit is None
+    # sub-GB degenerate value -> fallback (probe guard mirrored here).
+    p.write_text(json.dumps({"hbm_limit_gb": 0.25}))
+    assert load_hbm_limit(16.0, path=str(p))[0] == 16.0
