@@ -77,6 +77,16 @@ class RAFTConfig:
     # (raft.py corr.astype(dt)), so bf16 storage adds no new precision
     # class to training — and float32 otherwise (the reference's corr
     # dtype, corr.py:50, preserved whenever the model computes fp32).
+    # Default validated by the seed-paired storage A/B
+    # (AB_CORR_DTYPE.json, scripts/ab_corr_dtype.py, round 5): 150-step
+    # toy-chairs stages, arms differing ONLY in corr_dtype at matched
+    # seeds, runs bit-deterministic across processes.  Per-seed EPE
+    # diffs (bf16 - fp32): +2.52, -2.66, +0.29, -4.74, -1.30 — mean
+    # -1.18 +/- 1.19 stderr (t = -0.99, n = 5 pairs): no dtype effect
+    # resolvable against seed noise, sign favoring bf16 if anything.
+    # Real-data full-stage EPE remains the definitive test
+    # (docs/REAL_WEIGHTS_RUNBOOK.md); quality-critical runs can still
+    # pin 'float32' (~7% throughput give-back).
     corr_dtype: str = "auto"
     # MXU precision for the correlation matmul + window-sampling einsums:
     # 'default' (1 bf16 pass), 'high' (bf16x3), 'highest' (fp32), or
