@@ -81,9 +81,10 @@ class RAFTConfig:
     # (AB_CORR_DTYPE.json, scripts/ab_corr_dtype.py, round 5): 150-step
     # toy-chairs stages, arms differing ONLY in corr_dtype at matched
     # seeds, runs bit-deterministic across processes.  Per-seed EPE
-    # diffs (bf16 - fp32): +2.52, -2.66, +0.29, -4.74, -1.30 — mean
-    # -1.18 +/- 1.19 stderr (t = -0.99, n = 5 pairs): no dtype effect
-    # resolvable against seed noise, sign favoring bf16 if anything.
+    # diffs (bf16 - fp32): +2.52, -2.66, +0.29, -4.74, -1.30, -0.06 —
+    # mean -0.99 +/- 1.03 stderr (t = -0.96, n = 6 pairs): no dtype
+    # effect resolvable against seed noise, sign favoring bf16 if
+    # anything.
     # Real-data full-stage EPE remains the definitive test
     # (docs/REAL_WEIGHTS_RUNBOOK.md); quality-critical runs can still
     # pin 'float32' (~7% throughput give-back).
