@@ -1,0 +1,54 @@
+"""``python -m raft_tpu <subcommand>`` — the ``raft-tpu`` multi-tool.
+
+One stable entry point over the ``raft_tpu.cli`` modules (the repo-root
+``train.py``/``evaluate.py``/``demo.py`` shims keep the reference repo's
+UX; this is the installed-package spelling)::
+
+    python -m raft_tpu train --name raft-chairs --stage chairs ...
+    python -m raft_tpu evaluate --model checkpoints/raft-things ...
+    python -m raft_tpu demo --model checkpoints/raft-things --path frames/
+    python -m raft_tpu serve --model checkpoints/raft-things --port 8080
+    python -m raft_tpu lk-compare --model ... --image1 a.png --image2 b.png
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+_SUBCOMMANDS = {
+    "train": ("raft_tpu.cli.train", "offline training curriculum"),
+    "evaluate": ("raft_tpu.cli.evaluate", "validation / leaderboard eval"),
+    "demo": ("raft_tpu.cli.demo", "flow visualization over a frame dir"),
+    "serve": ("raft_tpu.cli.serve", "online HTTP inference server"),
+    "lk-compare": ("raft_tpu.cli.lk_compare",
+                   "RAFT vs Lucas-Kanade side-by-side"),
+}
+
+
+def _usage() -> str:
+    lines = ["usage: python -m raft_tpu <subcommand> [args...]", "",
+             "subcommands:"]
+    for name, (_, desc) in _SUBCOMMANDS.items():
+        lines.append(f"  {name:<12} {desc}")
+    lines.append("")
+    lines.append("run a subcommand with --help for its flags")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(_usage())
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd not in _SUBCOMMANDS:
+        print(f"unknown subcommand {cmd!r}\n\n{_usage()}",
+              file=sys.stderr)
+        return 2
+    module = importlib.import_module(_SUBCOMMANDS[cmd][0])
+    return module.main(rest)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,5 +6,9 @@ Run as modules::
     python -m raft_tpu.cli.train --name raft-chairs --stage chairs ...
     python -m raft_tpu.cli.evaluate --model checkpoints/raft-things ...
     python -m raft_tpu.cli.demo --model checkpoints/raft-things --path frames/
+    python -m raft_tpu.cli.serve --model checkpoints/raft-things --port 8080
     python -m raft_tpu.cli.lk_compare --model checkpoints/raft-things ...
+
+(or via the ``python -m raft_tpu <subcommand>`` multi-tool,
+``raft_tpu/__main__.py``)
 """

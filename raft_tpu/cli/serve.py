@@ -1,0 +1,212 @@
+"""Serving CLI: an HTTP front end over ``raft_tpu.serve.InferenceEngine``.
+
+Run as ``python -m raft_tpu serve ...`` (or ``python -m raft_tpu.cli.serve``).
+
+Protocol (stdlib-only on both ends, numpy's ``npz`` as the wire format —
+flow is float32 and PNG-style encodings lose the sign/scale):
+
+- ``POST /v1/flow``  body = ``np.savez(buf, image1=..., image2=...)``
+  with two matching ``(H, W, 3)`` arrays (uint8 or float32, [0, 255]).
+  Response 200: ``npz`` with ``flow`` ``(H, W, 2)`` float32 at the
+  original resolution.  Response 429 + ``Retry-After`` when the bounded
+  queue is full (shed load, retry with backoff); 400 on malformed input.
+- ``GET /v1/stats``  JSON engine snapshot (latency percentiles,
+  pairs/sec/chip, per-bucket compile counts).
+- ``GET /healthz``   200 once the engine accepts traffic.
+
+Example client::
+
+    import io, urllib.request, numpy as np
+    buf = io.BytesIO(); np.savez(buf, image1=im1, image2=im2)
+    r = urllib.request.urlopen(
+        urllib.request.Request("http://localhost:8080/v1/flow",
+                               data=buf.getvalue(), method="POST"))
+    flow = np.load(io.BytesIO(r.read()))["flow"]
+
+Each HTTP connection gets its own handler thread
+(``ThreadingHTTPServer``), so concurrent clients coalesce into the
+engine's micro-batches exactly like in-process callers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="raft-tpu serve",
+        description="RAFT-TPU online inference server: shape-bucketed "
+                    "compile cache + dynamic micro-batching "
+                    "(docs/SERVING.md)")
+    p.add_argument("--model", default=None,
+                   help="checkpoint directory (same layouts as the "
+                        "evaluate CLI); omit for --random-init")
+    p.add_argument("--random-init", action="store_true",
+                   help="serve randomly initialized weights (load/smoke "
+                        "testing without a checkpoint)")
+    p.add_argument("--small", action="store_true",
+                   help="small RAFT variant")
+    p.add_argument("--precision", default="bf16",
+                   choices=["bf16", "fp32"])
+    p.add_argument("--iters", type=int, default=32,
+                   help="refinement iterations per request")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="micro-batch size cap")
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="how long a micro-batch waits to fill after its "
+                        "first request (latency/throughput knob)")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="in-flight bound; beyond it requests get 429")
+    p.add_argument("--buckets", default=None,
+                   help="comma-separated /8-aligned HxW bucket ladder "
+                        "(e.g. 440x1024,720x1280); default: exact /8 "
+                        "round-up per request shape")
+    p.add_argument("--batch-sizes", default=None,
+                   help="comma-separated compiled batch sizes "
+                        "(default: powers of two up to --max-batch)")
+    p.add_argument("--warmup", default=None,
+                   help="comma-separated HxW image shapes to pre-compile "
+                        "before accepting traffic")
+    return p.parse_args(argv)
+
+
+def _parse_hw_list(spec):
+    out = []
+    for tok in spec.split(","):
+        h, w = tok.strip().lower().split("x")
+        out.append((int(h), int(w)))
+    return tuple(out)
+
+
+def _make_handler(engine):
+    from http.server import BaseHTTPRequestHandler
+
+    from raft_tpu.serve import QueueFullError
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # stats() is the signal;
+            pass                            # per-request stderr is noise
+
+        def _reply(self, code, body, ctype, extra=()):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in extra:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, code, obj, extra=()):
+            self._reply(code, json.dumps(obj).encode(),
+                        "application/json", extra)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, b"ok", "text/plain")
+            elif self.path == "/v1/stats":
+                self._reply_json(200, engine.stats())
+            else:
+                self._reply_json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            import numpy as np
+
+            if self.path != "/v1/flow":
+                self._reply_json(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                with np.load(io.BytesIO(self.rfile.read(n))) as z:
+                    im1, im2 = z["image1"], z["image2"]
+            except Exception as e:
+                self._reply_json(400, {"error": f"bad npz body: {e}"})
+                return
+            try:
+                flow = engine.infer(im1, im2)
+            except QueueFullError as e:
+                self._reply_json(429, {"error": str(e)},
+                                 extra=[("Retry-After", "1")])
+                return
+            except ValueError as e:
+                self._reply_json(400, {"error": str(e)})
+                return
+            buf = io.BytesIO()
+            np.savez(buf, flow=flow)
+            self._reply(200, buf.getvalue(), "application/octet-stream")
+
+    return Handler
+
+
+def make_server(engine, host: str, port: int):
+    """A ``ThreadingHTTPServer`` bound to ``host:port`` (port 0 picks a
+    free port — tests), serving the engine.  Caller owns lifecycle."""
+    from http.server import ThreadingHTTPServer
+
+    return ThreadingHTTPServer((host, port), _make_handler(engine))
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if (args.model is None) == (not args.random_init):
+        raise SystemExit("exactly one of --model / --random-init required")
+
+    import jax
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.serve import InferenceEngine, ServeConfig
+
+    mk = RAFTConfig.small_model if args.small else RAFTConfig.full
+    model_cfg = mk(compute_dtype="bfloat16" if args.precision == "bf16"
+                   else "float32")
+    if args.model:
+        from raft_tpu.cli.evaluate import load_model_variables
+
+        variables = load_model_variables(args.model)
+        if "batch_stats" not in variables:
+            variables = dict(variables, batch_stats={})
+    else:
+        from raft_tpu.models.raft import RAFT
+
+        rng = jax.random.PRNGKey(0)
+        img = jax.numpy.zeros((1, 64, 96, 3))
+        variables = RAFT(model_cfg).init(
+            {"params": rng, "dropout": rng}, img, img, iters=1)
+
+    serve_cfg = ServeConfig(
+        iters=args.iters, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        buckets=_parse_hw_list(args.buckets) if args.buckets else None,
+        batch_sizes=tuple(int(b) for b in args.batch_sizes.split(","))
+        if args.batch_sizes else None)
+    engine = InferenceEngine(variables, model_cfg, serve_cfg)
+    engine.start()
+    if args.warmup:
+        shapes = _parse_hw_list(args.warmup)
+        print(f"warmup: compiling {len(shapes)} shape(s)...", flush=True)
+        engine.warmup(shapes)
+
+    server = make_server(engine, args.host, args.port)
+    host, port = server.server_address[:2]
+    print(f"raft-tpu serve: listening on http://{host}:{port} "
+          f"(backend={jax.default_backend()}, "
+          f"max_batch={args.max_batch}, max_wait_ms={args.max_wait_ms}, "
+          f"max_queue={args.max_queue})", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        engine.stop()
+        print(json.dumps(engine.stats()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,7 @@ import numpy as np
 from raft_tpu.config import RAFTConfig
 from raft_tpu.data import datasets, frame_utils
 from raft_tpu.models.raft import RAFT
-from raft_tpu.ops.pad import InputPadder
+from raft_tpu.ops.pad import InputPadder, max_bucket_hw
 from raft_tpu.utils.warp import forward_interpolate
 
 
@@ -45,23 +45,30 @@ def default_alternate_corr_impl() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "chunked"
 
 
-def make_eval_fn(model_cfg: RAFTConfig, iters: int):
-    """Jitted ``(variables, image1, image2, flow_init) -> (flow_low,
-    flow_up)`` test-mode forward.  ``flow_init`` may be None (traced as a
-    static branch via two separate jit entries).
+def make_inference_model(model_cfg: RAFTConfig) -> RAFT:
+    """The RAFT module with the inference-only config overrides applied.
 
-    Inference-only overrides live here once (every inference entry point
-    funnels through this function): the scan unroll is forced to 1 (the
-    config default tunes the training backward pass; at 32 forward-only
-    iterations unroll 6 measured 10.8 vs 11.9 frames/s on v5e), and the
-    training-optimized ``allpairs_pallas`` impl maps back to ``allpairs``
-    (10.4 vs 12.0 frames/s at the Sintel eval shape, whose W/8=128 rows
-    fill the MXU lane tile).  Explicit memory-saving choices (``chunked``
-    / ``pallas``) are respected."""
+    Every inference entry point (the validators here, the serving engine
+    in ``raft_tpu/serve``) funnels through this function so the overrides
+    live once: the scan unroll is forced to 1 (the config default tunes
+    the training backward pass; at 32 forward-only iterations unroll 6
+    measured 10.8 vs 11.9 frames/s on v5e), and the training-optimized
+    ``allpairs_pallas`` impl maps back to ``allpairs`` (10.4 vs 12.0
+    frames/s at the Sintel eval shape, whose W/8=128 rows fill the MXU
+    lane tile).  Explicit memory-saving choices (``chunked`` /
+    ``pallas``) are respected."""
     overrides = {"scan_unroll": 1}
     if model_cfg.corr_impl == "allpairs_pallas":
         overrides["corr_impl"] = "allpairs"
-    model = RAFT(model_cfg.replace(**overrides))
+    return RAFT(model_cfg.replace(**overrides))
+
+
+def make_eval_fn(model_cfg: RAFTConfig, iters: int):
+    """Jitted ``(variables, image1, image2, flow_init) -> (flow_low,
+    flow_up)`` test-mode forward.  ``flow_init`` may be None (traced as a
+    static branch via two separate jit entries).  Inference-only config
+    overrides are applied by :func:`make_inference_model`."""
+    model = make_inference_model(model_cfg)
 
     @jax.jit
     def fwd(variables, image1, image2):
@@ -127,9 +134,7 @@ def _bucket_hw(ds) -> tuple:
     if hit is None:
         if len(_BUCKET_CACHE) >= 64:   # a handful of dataset variants is
             _BUCKET_CACHE.clear()      # the use case; don't grow forever
-        hs, ws = zip(*(_peek_hw(p) for p in key))
-        hit = _BUCKET_CACHE[key] = (-(-max(hs) // 8) * 8,
-                                    -(-max(ws) // 8) * 8)
+        hit = _BUCKET_CACHE[key] = max_bucket_hw(_peek_hw(p) for p in key)
     return hit
 
 

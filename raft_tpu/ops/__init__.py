@@ -4,7 +4,12 @@ from raft_tpu.ops.sampler import (
     resize_bilinear_align_corners,
     upflow8,
 )
-from raft_tpu.ops.pad import InputPadder
+from raft_tpu.ops.pad import (
+    InputPadder,
+    bucket_hw,
+    ceil_to_multiple,
+    max_bucket_hw,
+)
 from raft_tpu.ops.upsample import convex_upsample
 from raft_tpu.ops.corr import (
     all_pairs_correlation,
@@ -18,6 +23,9 @@ __all__ = [
     "resize_bilinear_align_corners",
     "upflow8",
     "InputPadder",
+    "bucket_hw",
+    "ceil_to_multiple",
+    "max_bucket_hw",
     "convex_upsample",
     "all_pairs_correlation",
     "build_corr_pyramid",

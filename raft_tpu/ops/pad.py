@@ -9,14 +9,59 @@ multiple of 8 — the batched-evaluation path pads every KITTI resolution to
 one common shape so the jitted forward compiles once (the placement policy
 of the mode is preserved, and edge-replicate rows are identical however
 many there are).
+
+Bucket policy lives here too (:func:`ceil_to_multiple`,
+:func:`bucket_hw`): both the offline validators
+(``raft_tpu/evaluate.py``) and the serving engine
+(``raft_tpu/serve/engine.py``) round request shapes to /8-aligned compile
+buckets, and keeping the rounding in one place means eval and serve
+cannot drift in which shapes they consider "the same program".
 """
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Iterable, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
+
+
+def ceil_to_multiple(x: int, multiple: int = 8) -> int:
+    """Smallest multiple of ``multiple`` that is >= ``x``."""
+    return -(-int(x) // multiple) * multiple
+
+
+def bucket_hw(ht: int, wd: int, multiple: int = 8,
+              ladder: Optional[Sequence[Tuple[int, int]]] = None,
+              ) -> Tuple[int, int]:
+    """The /``multiple``-aligned compile bucket covering an ``(ht, wd)``
+    image.
+
+    Without a ``ladder`` this is the exact next-multiple round-up (one
+    bucket per distinct aligned shape — what the validators use, where
+    the shape population is known up front).  With a ``ladder`` of
+    ``(H, W)`` bucket shapes, the smallest ladder entry that covers the
+    image wins — a serving engine with unknown traffic uses a coarse
+    ladder so nearby resolutions coalesce into one micro-batch instead
+    of fragmenting into per-shape programs.  Images larger than every
+    ladder entry fall back to the exact round-up (served correctly, at
+    the cost of a dedicated compile)."""
+    bh, bw = ceil_to_multiple(ht, multiple), ceil_to_multiple(wd, multiple)
+    if ladder:
+        fits = [(H, W) for (H, W) in ladder if H >= bh and W >= bw]
+        if fits:
+            return min(fits, key=lambda t: (t[0] * t[1], t))
+    return bh, bw
+
+
+def max_bucket_hw(shapes: Iterable[Tuple[int, int]],
+                  multiple: int = 8) -> Tuple[int, int]:
+    """One bucket covering every ``(ht, wd)`` in ``shapes`` (the
+    validators' pad-everything-to-the-max policy, so a whole mixed-
+    resolution split costs ONE compile)."""
+    hs, ws = zip(*shapes)
+    return ceil_to_multiple(max(hs), multiple), \
+        ceil_to_multiple(max(ws), multiple)
 
 
 class InputPadder:
@@ -27,8 +72,8 @@ class InputPadder:
                  target: Optional[Tuple[int, int]] = None):
         self.ht, self.wd = dims[-3:-1] if len(dims) >= 3 else dims
         if target is None:
-            pad_ht = (((self.ht // 8) + 1) * 8 - self.ht) % 8
-            pad_wd = (((self.wd // 8) + 1) * 8 - self.wd) % 8
+            pad_ht = ceil_to_multiple(self.ht) - self.ht
+            pad_wd = ceil_to_multiple(self.wd) - self.wd
         else:
             pad_ht, pad_wd = target[0] - self.ht, target[1] - self.wd
             assert pad_ht >= 0 and pad_wd >= 0, (

@@ -1051,6 +1051,13 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
             df2_by_level[lvl] = out
 
     if blocked:
+        # Captured at TRACE time: this read happens inside the custom-vjp
+        # backward while jit traces it, and the jit cache keys on shapes/
+        # dtypes only — changing RAFT_ODM_BWD_BLOCK_Q later in the same
+        # process silently returns the old program (an in-process sweep
+        # would record identical timings for different nominal values).
+        # Sweep with a fresh process per value (scripts/tpu_backlog_r05.sh
+        # does), or plumb it through RAFTConfig like lookup_block_q.
         bq2 = int(os.environ.get("RAFT_ODM_BWD_BLOCK_Q", _BWD_BLOCK_Q))
         f1p2, cp2, _ = _pad_queries(f1, c, bq2)
         Npad2 = f1p2.shape[1]

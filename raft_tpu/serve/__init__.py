@@ -1,0 +1,23 @@
+"""Online inference serving (see ``raft_tpu/serve/engine.py`` for the
+architecture: shape-bucketed AOT compile cache + dynamic micro-batching
++ bounded-queue backpressure).
+
+Entry points::
+
+    python -m raft_tpu serve --small --port 8080   # HTTP server
+    python scripts/bench_serve.py --tiny           # load generator
+"""
+
+from raft_tpu.serve.engine import (
+    InferenceEngine,
+    QueueFullError,
+    ServeConfig,
+)
+from raft_tpu.serve.stats import LatencyRecorder
+
+__all__ = [
+    "InferenceEngine",
+    "QueueFullError",
+    "ServeConfig",
+    "LatencyRecorder",
+]

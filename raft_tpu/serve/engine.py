@@ -1,0 +1,407 @@
+"""Async TPU inference serving engine: shape buckets + dynamic batching.
+
+The offline entry points (``train.py`` / ``evaluate.py`` / ``demo.py``)
+stream known datasets; a server sees *concurrent requests of unknown
+resolution*.  Two classic levers make that fast on XLA backends, and both
+live here:
+
+1. **Shape-bucketed compile cache.**  Every request's ``(H, W)`` is
+   rounded to a /8-aligned bucket (:func:`raft_tpu.ops.pad.bucket_hw` —
+   the same policy the validators use, optionally snapped to a coarse
+   configured ladder), and the engine keeps one AOT-compiled test-mode
+   forward per ``(bucket_hw, batch_size)``.  Ahead-of-time
+   ``jit.lower(...).compile()`` — not plain ``jax.jit`` call-site caching
+   — so a compile is an *explicit, counted event*
+   (:class:`raft_tpu.utils.profiling.CompileCounter`) and a shape that
+   slipped past the bucketing would raise instead of silently
+   recompiling per request.  Steady-state traffic never compiles.
+
+2. **Dynamic micro-batching.**  Requests landing in the same bucket are
+   coalesced by a per-bucket dispatcher into one device batch: the batch
+   closes at ``max_batch`` items or ``max_wait_ms`` after its first
+   item, whichever comes first (the latency/throughput trade-off knob —
+   docs/SERVING.md).  Partial batches are padded (repeating the last
+   item) up to the nearest compiled batch size, so batch shapes come
+   from a small fixed set.
+
+Architecture (three kinds of thread, one device):
+
+- caller threads: ``submit()`` — bucket lookup, backpressure check,
+  handoff to the engine's event loop.  Returns a
+  ``concurrent.futures.Future``.
+- the engine's asyncio loop thread: per-bucket dispatcher tasks coalesce
+  micro-batches.  Pure bookkeeping, never touches the device.
+- one device-worker thread: pads/stacks the batch, runs the compiled
+  executable, unpads per-request results.  Single-threaded by
+  construction so device work serializes instead of interleaving.
+
+Backpressure: a bounded in-flight count (``max_queue``).  ``submit()``
+beyond it raises :class:`QueueFullError` (the HTTP layer maps it to 429)
+— the queue can never grow without bound, and latency under overload
+stays bounded instead of collapsing.
+
+Scope: single-host, single-device per engine (multi-chip serving is one
+engine process per chip behind an external balancer); requests are
+stateless frame pairs (no cross-request warm start).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.ops.pad import InputPadder, bucket_hw
+from raft_tpu.serve.stats import Counters, LatencyRecorder
+from raft_tpu.utils.profiling import CompileCounter
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure rejection: ``max_queue`` requests already in flight.
+
+    The 429-style signal — the caller should shed load or retry with
+    backoff; the engine never queues without bound."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine knobs (model hyperparameters stay in ``RAFTConfig``).
+
+    ``max_wait_ms`` trades tail latency for batch fill: 0 ships every
+    request alone (lowest latency, worst throughput); large values fill
+    batches under light traffic but add up to that wait to p99.
+    ``buckets`` is an optional explicit ``(H, W)`` ladder — with unknown
+    traffic a coarse ladder coalesces nearby resolutions into one
+    program instead of fragmenting per shape.  ``batch_sizes`` is the
+    set of compiled batch shapes (default: powers of two up to
+    ``max_batch``); micro-batches round up to the nearest one."""
+
+    iters: int = 32
+    max_batch: int = 8
+    max_wait_ms: float = 5.0
+    max_queue: int = 256
+    bucket_multiple: int = 8
+    buckets: Optional[Tuple[Tuple[int, int], ...]] = None
+    batch_sizes: Optional[Tuple[int, ...]] = None
+    pad_mode: str = "sintel"
+    latency_window: int = 4096
+
+    def __post_init__(self):
+        if self.max_batch < 1 or self.max_queue < 1:
+            raise ValueError("max_batch and max_queue must be >= 1")
+        if self.max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        m = self.bucket_multiple
+        for hw in self.buckets or ():
+            if hw[0] % m or hw[1] % m:
+                raise ValueError(
+                    f"bucket {hw} not /{m}-aligned (the model "
+                    f"downsamples by {m})")
+
+    def resolved_batch_sizes(self) -> Tuple[int, ...]:
+        if self.batch_sizes:
+            sizes = tuple(sorted({int(b) for b in self.batch_sizes}))
+            if sizes[0] < 1:
+                raise ValueError(f"batch_sizes must be >= 1: {sizes}")
+            return sizes
+        sizes, b = set(), 1
+        while b < self.max_batch:
+            sizes.add(b)
+            b *= 2
+        sizes.add(self.max_batch)
+        return tuple(sorted(sizes))
+
+
+class _Request:
+    __slots__ = ("image1", "image2", "bucket", "padder", "future",
+                 "t_submit")
+
+    def __init__(self, image1, image2, bucket, padder):
+        self.image1 = image1
+        self.image2 = image2
+        self.bucket = bucket
+        self.padder = padder
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+
+
+class InferenceEngine:
+    """See module docstring.  Lifecycle::
+
+        engine = InferenceEngine(variables, model_cfg, ServeConfig(...))
+        engine.start()                      # or: with engine: ...
+        engine.warmup([(436, 1024)])        # optional pre-compile
+        flow = engine.infer(image1, image2)  # or .submit() -> Future
+        print(engine.stats())
+        engine.stop()
+    """
+
+    def __init__(self, variables, model_cfg: RAFTConfig,
+                 cfg: ServeConfig = ServeConfig()):
+        # Deferred import: evaluate.py pulls the dataset stack, and the
+        # dependency is one function (the shared inference overrides).
+        from raft_tpu.evaluate import make_inference_model
+
+        self.cfg = cfg
+        model = make_inference_model(model_cfg)
+        self._fwd = jax.jit(
+            lambda v, a, b: model.apply(v, a, b, iters=cfg.iters,
+                                        test_mode=True, train=False))
+        # Keep params resident on device: the executable is called with
+        # this exact pytree every batch, so requests never re-upload it.
+        self._variables = jax.device_put(variables)
+        self._batch_sizes = cfg.resolved_batch_sizes()
+        self._max_group = min(cfg.max_batch, self._batch_sizes[-1])
+
+        self._executables: Dict[tuple, object] = {}
+        self._compile_lock = threading.Lock()
+        self.compile_counter = CompileCounter()
+
+        self._latency = LatencyRecorder(cfg.latency_window)
+        self._counters = Counters()
+
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._queues: Dict[tuple, asyncio.Queue] = {}
+        self._dispatchers: Dict[tuple, asyncio.Task] = {}
+        self._device_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="raft-serve-device")
+        self._accepting = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> "InferenceEngine":
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(self._loop)
+            self._loop.call_soon(started.set)
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, name="raft-serve-loop",
+                                        daemon=True)
+        self._thread.start()
+        started.wait()
+        self._counters.mark_started()
+        self._accepting = True
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop accepting, optionally drain in-flight work, shut down.
+
+        Queued requests that cannot complete (``drain=False`` or drain
+        timeout) fail with ``RuntimeError('engine stopped')``."""
+        if self._thread is None:
+            return
+        self._accepting = False
+        if drain:
+            deadline = time.perf_counter() + timeout
+            while time.perf_counter() < deadline:
+                with self._pending_lock:
+                    if self._pending == 0:
+                        break
+                time.sleep(0.005)
+
+        async def _cancel_all():
+            tasks = list(self._dispatchers.values())
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        asyncio.run_coroutine_threadsafe(
+            _cancel_all(), self._loop).result(timeout=10)
+        self._device_pool.shutdown(wait=True)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+        self._loop.close()
+        self._thread = None
+        self._dispatchers.clear()
+        self._queues.clear()
+
+    def __enter__(self) -> "InferenceEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------
+    # client API (any thread)
+    # ------------------------------------------------------------------
+
+    def submit(self, image1, image2) -> Future:
+        """Enqueue one frame pair; returns a Future resolving to the
+        ``(H, W, 2)`` float32 flow at the ORIGINAL resolution.
+
+        Raises :class:`QueueFullError` immediately (never blocks) when
+        ``max_queue`` requests are already in flight."""
+        if not self._accepting:
+            raise RuntimeError("engine not started (or stopping)")
+        im1 = np.asarray(image1, dtype=np.float32)
+        im2 = np.asarray(image2, dtype=np.float32)
+        if im1.ndim != 3 or im1.shape[-1] != 3 or im1.shape != im2.shape:
+            raise ValueError(
+                f"expected two matching (H, W, 3) images, got "
+                f"{im1.shape} and {im2.shape}")
+        h, w = im1.shape[:2]
+        bucket = bucket_hw(h, w, self.cfg.bucket_multiple, self.cfg.buckets)
+        padder = InputPadder((h, w), mode=self.cfg.pad_mode, target=bucket)
+        with self._pending_lock:
+            if self._pending >= self.cfg.max_queue:
+                self._counters.add_rejected()
+                raise QueueFullError(
+                    f"{self._pending} requests in flight >= max_queue="
+                    f"{self.cfg.max_queue}; retry later")
+            self._pending += 1
+        req = _Request(im1, im2, bucket, padder)
+        try:
+            self._loop.call_soon_threadsafe(self._enqueue, req)
+        except RuntimeError:  # loop closed under our feet (stop race)
+            with self._pending_lock:
+                self._pending -= 1
+            raise RuntimeError("engine stopped")
+        return req.future
+
+    def infer(self, image1, image2,
+              timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(image1, image2).result(timeout=timeout)
+
+    def warmup(self, image_shapes: Sequence[Tuple[int, int]],
+               batch_sizes: Optional[Sequence[int]] = None) -> List[tuple]:
+        """Pre-compile the ``(bucket, batch)`` programs for the given raw
+        image ``(H, W)`` shapes (rounded through the same bucket policy
+        as live traffic), so first requests don't pay the compile.
+        Returns the list of keys compiled or already present."""
+        keys = []
+        for (h, w) in image_shapes:
+            bucket = bucket_hw(h, w, self.cfg.bucket_multiple,
+                               self.cfg.buckets)
+            for bs in (batch_sizes or self._batch_sizes):
+                self._get_executable(bucket, int(bs))
+                keys.append((bucket, int(bs)))
+        return keys
+
+    def stats(self) -> dict:
+        """One JSON-able snapshot: counters, latency percentiles over the
+        recent window, per-``(bucket, batch)`` compile counts."""
+        out = self._counters.snapshot(max(jax.local_device_count(), 1))
+        with self._pending_lock:
+            out["pending"] = self._pending
+        out["latency_ms"] = self._latency.snapshot()
+        out["compiles"] = {
+            f"{hw[0]}x{hw[1]}/b{bs}": n
+            for (hw, bs), n in sorted(self.compile_counter.counts().items())
+        }
+        out["num_buckets"] = len(
+            {hw for (hw, _) in self.compile_counter.counts()})
+        return out
+
+    # ------------------------------------------------------------------
+    # internals — event-loop thread
+    # ------------------------------------------------------------------
+
+    def _enqueue(self, req: _Request) -> None:
+        q = self._queues.get(req.bucket)
+        if q is None:
+            q = self._queues[req.bucket] = asyncio.Queue()
+            self._dispatchers[req.bucket] = self._loop.create_task(
+                self._dispatcher(req.bucket, q))
+        q.put_nowait(req)
+
+    async def _dispatcher(self, bucket: tuple, q: asyncio.Queue) -> None:
+        """Coalesce one bucket's requests into micro-batches forever.
+
+        The device call is NOT awaited: the single worker thread
+        serializes device work, and not awaiting lets the next batch
+        fill while the previous one runs (pipelining the host-side
+        pad/stack with device execution)."""
+        batch: List[_Request] = []
+        try:
+            while True:
+                batch = [await q.get()]
+                deadline = self._loop.time() + self.cfg.max_wait_ms / 1e3
+                while len(batch) < self._max_group:
+                    wait = deadline - self._loop.time()
+                    if wait <= 0:
+                        break
+                    try:
+                        batch.append(
+                            await asyncio.wait_for(q.get(), timeout=wait))
+                    except asyncio.TimeoutError:
+                        break
+                fut = self._loop.run_in_executor(
+                    self._device_pool, self._run_batch, bucket, batch)
+                batch = []
+                fut.add_done_callback(lambda f: f.exception())
+        except asyncio.CancelledError:
+            leftovers = batch
+            while not q.empty():
+                leftovers.append(q.get_nowait())
+            for r in leftovers:
+                if not r.future.done():
+                    r.future.set_exception(RuntimeError("engine stopped"))
+            if leftovers:
+                with self._pending_lock:
+                    self._pending -= len(leftovers)
+            raise
+
+    # ------------------------------------------------------------------
+    # internals — device-worker thread
+    # ------------------------------------------------------------------
+
+    def _get_executable(self, bucket: tuple, batch_size: int):
+        key = (bucket, batch_size)
+        with self._compile_lock:
+            exe = self._executables.get(key)
+            if exe is None:
+                H, W = bucket
+                spec = jax.ShapeDtypeStruct((batch_size, H, W, 3),
+                                            jnp.float32)
+                exe = self._fwd.lower(
+                    self._variables, spec, spec).compile()
+                self._executables[key] = exe
+                self.compile_counter.record(key)
+        return exe
+
+    def _run_batch(self, bucket: tuple, reqs: List[_Request]) -> None:
+        try:
+            n = len(reqs)
+            bs = next(s for s in self._batch_sizes if s >= n)
+            exe = self._get_executable(bucket, bs)
+            im1 = [r.padder.pad_np(r.image1) for r in reqs]
+            im2 = [r.padder.pad_np(r.image2) for r in reqs]
+            if bs > n:  # ballast lanes keep the compiled batch shape
+                im1 += [im1[-1]] * (bs - n)
+                im2 += [im2[-1]] * (bs - n)
+            _, flow_up = exe(self._variables, np.stack(im1), np.stack(im2))
+            flow_up = np.asarray(flow_up)
+            t_done = time.perf_counter()
+            for j, r in enumerate(reqs):
+                r.future.set_result(
+                    np.asarray(r.padder.unpad(flow_up[j:j + 1])[0]))
+                self._latency.record(t_done - r.t_submit)
+            self._counters.add_batch(real=n, padded=bs - n, failed=False)
+        except Exception as e:
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            self._counters.add_batch(real=0, padded=0, failed=True)
+        finally:
+            with self._pending_lock:
+                self._pending -= len(reqs)

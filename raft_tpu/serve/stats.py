@@ -1,0 +1,106 @@
+"""Serving metrics: latency percentiles + throughput counters.
+
+The engine records one latency sample per completed request (submit ->
+result, i.e. including queueing and batching delay — the number a client
+actually experiences) into a bounded ring, so a long-running server's
+``stats()`` reflects *recent* traffic and memory stays O(window).
+Percentiles are computed on snapshot, not on record: the record path is
+on the request hot path, the snapshot path is a human asking.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class LatencyRecorder:
+    """Bounded ring of per-request latencies with percentile snapshots.
+
+    Thread-safe: requests complete on the device-worker thread while
+    ``snapshot`` is called from CLI/HTTP threads."""
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=window)
+        self._count = 0
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._ring.append(seconds)
+            self._count += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        """``{count, p50_ms, p95_ms, p99_ms, mean_ms}`` over the recent
+        window (``count`` is lifetime; zeros when nothing completed)."""
+        with self._lock:
+            vals = np.asarray(self._ring, dtype=np.float64)
+            count = self._count
+        if vals.size == 0:
+            return {"count": 0, "p50_ms": 0.0, "p95_ms": 0.0,
+                    "p99_ms": 0.0, "mean_ms": 0.0}
+        p50, p95, p99 = np.percentile(vals, [50, 95, 99]) * 1e3
+        return {"count": count,
+                "p50_ms": round(float(p50), 3),
+                "p95_ms": round(float(p95), 3),
+                "p99_ms": round(float(p99), 3),
+                "mean_ms": round(float(vals.mean() * 1e3), 3)}
+
+
+class Counters:
+    """Lifetime request/batch counters (lock-shared with the engine).
+
+    ``padded_lanes`` counts batch lanes filled with repeated ballast to
+    reach a compiled batch size — ``occupancy`` (real / total lanes) is
+    the knob-tuning signal for ``max_wait_ms`` vs ``max_batch``."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.completed = 0
+        self.rejected = 0
+        self.errors = 0
+        self.batches = 0
+        self.padded_lanes = 0
+        self._t0: Optional[float] = None
+
+    def mark_started(self) -> None:
+        with self._lock:
+            self._t0 = time.perf_counter()
+
+    def add_rejected(self, n: int = 1) -> None:
+        with self._lock:
+            self.rejected += n
+
+    def add_batch(self, real: int, padded: int, failed: bool) -> None:
+        with self._lock:
+            self.batches += 1
+            self.padded_lanes += padded
+            if failed:
+                self.errors += 1
+            else:
+                self.completed += real
+
+    def snapshot(self, num_chips: int) -> Dict[str, float]:
+        with self._lock:
+            uptime = (time.perf_counter() - self._t0) if self._t0 else 0.0
+            total_lanes = self.completed + self.padded_lanes
+            return {
+                "uptime_s": round(uptime, 3),
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "errors": self.errors,
+                "batches": self.batches,
+                "mean_batch_fill": round(self.completed / self.batches, 3)
+                if self.batches else 0.0,
+                "occupancy": round(self.completed / total_lanes, 3)
+                if total_lanes else 0.0,
+                "pairs_per_sec": round(self.completed / uptime, 3)
+                if uptime > 0 else 0.0,
+                "pairs_per_sec_per_chip":
+                    round(self.completed / uptime / num_chips, 3)
+                    if uptime > 0 else 0.0,
+            }

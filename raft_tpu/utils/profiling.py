@@ -10,9 +10,46 @@ from __future__ import annotations
 
 import dataclasses
 import os.path as osp
-from typing import Optional
+import threading
+from typing import Dict, Hashable, Optional
 
 import jax
+
+
+class CompileCounter:
+    """Per-key compile-event accounting.
+
+    XLA exposes no portable "how many programs did this process build"
+    counter, so callers that manage their own executables (the serving
+    engine's AOT-compiled ``(bucket, batch)`` forwards,
+    ``raft_tpu/serve/engine.py``) record one event per executable they
+    actually build.  Tests then assert the serving invariant directly:
+    steady-state traffic compiles exactly once per key, never per
+    request.  Thread-safe (the engine compiles from worker threads)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[Hashable, int] = {}
+
+    def record(self, key: Hashable) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def count(self, key: Hashable) -> int:
+        with self._lock:
+            return self._counts.get(key, 0)
+
+    def counts(self) -> Dict[Hashable, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._counts.values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
 
 
 @dataclasses.dataclass
@@ -104,6 +141,18 @@ def hbm_usage(compiled_or_fn, *args) -> dict:
         return {"peak_hbm": f"unavailable ({type(e).__name__})"}
 
 
+def probe_error_is_oom(exc: BaseException) -> bool:
+    """Whether an allocation-probe failure is an out-of-memory verdict.
+
+    XLA surfaces allocator refusal as RESOURCE_EXHAUSTED (sometimes just
+    an "out of memory"/"OOM" message, depending on backend and path).
+    Anything else — a dead relay tunnel, a DEADLINE_EXCEEDED, an
+    INTERNAL error — is a *broken probe*, not a measurement."""
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return ("resource_exhausted" in msg or "resource exhausted" in msg
+            or "out of memory" in msg or "oom" in msg)
+
+
 def measure_hbm_limit(max_gb: float = 64.0, chunk_mb: int = 256) -> dict:
     """Measured usable device-memory limit via an allocation probe.
 
@@ -114,6 +163,12 @@ def measure_hbm_limit(max_gb: float = 64.0, chunk_mb: int = 256) -> dict:
     verdict actually needs (the XLA allocator reserves a slice of the
     16 GB spec for itself, so the spec constant overstates headroom —
     VERDICT r4 weak #4).  TPU-only: the CPU backend would happily swap.
+
+    Only an OOM-classified failure (:func:`probe_error_is_oom`)
+    terminates the probe as a measurement; any other error (e.g. the
+    relay tunnel dying mid-probe) returns the ``"unavailable"`` marker
+    so a flaky backend can't write a plausible-but-wrong
+    ``HBM_LIMIT.json`` that poisons every downstream "fits" verdict.
 
     Returns ``{"hbm_limit_gb": float, "source": str}`` or a
     ``{"hbm_limit_gb": "unavailable"}`` marker off-TPU.
@@ -136,8 +191,12 @@ def measure_hbm_limit(max_gb: float = 64.0, chunk_mb: int = 256) -> dict:
             try:
                 buf = jax.device_put(jnp.zeros((n,), jnp.float32), dev)
                 buf.block_until_ready()
-            except Exception:
-                break
+            except Exception as e:
+                if probe_error_is_oom(e):
+                    break  # allocator refused: that IS the measurement
+                return {"hbm_limit_gb": "unavailable",
+                        "source": ("allocation probe aborted by non-OOM "
+                                   f"{type(e).__name__}: {str(e)[:160]}")}
             held.append(buf)
             total_mb += chunk_mb
     finally:
@@ -181,18 +240,44 @@ def load_hbm_limit(default_gb=None, path=None):
     return default_gb, "no (valid) HBM_LIMIT.json"
 
 
-def enable_persistent_compile_cache() -> str:
-    """Turn on JAX's persistent XLA compilation cache at one shared
-    location.  Multi-run harnesses (the corr-dtype A/B, the toy
-    curriculum) build a fresh jit closure per stage, so without this
-    every stage recompiles programs an earlier stage already built —
-    ~40 min/program on the 1-core CPU fallback, ~20-40 s each on TPU.
-    Returns the cache directory."""
+def default_compile_cache_dir() -> str:
+    """Per-user persistent-compile-cache location.
+
+    ``RAFT_JAX_CACHE_DIR`` overrides outright; otherwise the directory
+    embeds uid+username under the system tempdir.  The old world-shared
+    ``/tmp/raft_jaxcache`` let any local user pre-create the path (mode
+    and ownership theirs) and feed poisoned cache entries to — or simply
+    break — every other user's runs."""
+    import getpass
+    import os
     import tempfile
+
+    override = os.environ.get("RAFT_JAX_CACHE_DIR")
+    if override:
+        return override
+    uid = getattr(os, "getuid", lambda: None)()
+    try:
+        user = getpass.getuser()
+    except Exception:  # no passwd entry for the uid (minimal containers)
+        user = None
+    ident = "-".join(str(x) for x in (uid, user) if x is not None) or "user"
+    return osp.join(tempfile.gettempdir(), f"raft_jaxcache-{ident}")
+
+
+def enable_persistent_compile_cache() -> str:
+    """Turn on JAX's persistent XLA compilation cache at one per-user
+    location (:func:`default_compile_cache_dir`), created mode 0700.
+    Multi-run harnesses (the corr-dtype A/B, the toy curriculum) build a
+    fresh jit closure per stage, so without this every stage recompiles
+    programs an earlier stage already built — ~40 min/program on the
+    1-core CPU fallback, ~20-40 s each on TPU.  Returns the cache
+    directory."""
+    import os
 
     import jax
 
-    cache_dir = osp.join(tempfile.gettempdir(), "raft_jaxcache")
+    cache_dir = default_compile_cache_dir()
+    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     return cache_dir

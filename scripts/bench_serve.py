@@ -1,0 +1,213 @@
+"""Serving-engine load generator: closed-loop and Poisson open-loop.
+
+Drives ``raft_tpu.serve.InferenceEngine`` in-process (no HTTP overhead in
+the measurement) with mixed-resolution synthetic frame pairs and prints
+ONE JSON line per run in the ``bench.py`` format (metric / value / unit /
+vs_baseline), plus the client-observed latency percentiles and the
+engine's compile ledger.
+
+Two canonical load shapes:
+
+- ``--mode closed``: ``--concurrency`` workers each keep exactly one
+  request in flight (submit, wait, repeat) — the saturation-throughput
+  number, what "pairs/sec/chip can this engine do" means.
+- ``--mode open``: requests arrive on a Poisson process at ``--rate``
+  req/s regardless of completions — the production-realistic number,
+  where latency percentiles and 429 rejections are the story (an open
+  loop keeps arriving while the server falls behind; a closed loop
+  politely waits and hides the collapse).
+
+``--tiny``: CPU-friendly smoke preset (small model, fp32, 2 iters, two
+tiny resolutions) so the serving path stays testable without hardware::
+
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --tiny
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --tiny --mode open
+
+There is no external serving baseline (the reference repo has no request
+path at all); ``vs_baseline`` is 0.0 until a measured TPU number lands
+in a ``BENCH_SERVE_r*.json`` and becomes the bar, like bench.py's eval
+mode did in round 3.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="RAFT-TPU serving benchmark")
+    p.add_argument("--mode", default="closed", choices=["closed", "open"])
+    p.add_argument("--tiny", action="store_true",
+                   help="CPU smoke preset (small model, 2 iters, tiny "
+                        "shapes, few requests)")
+    p.add_argument("--shapes", default="440x1024",
+                   help="comma-separated HxW request resolutions, cycled "
+                        "round-robin (mixed-shape traffic)")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="closed-loop: in-flight requests")
+    p.add_argument("--rate", type=float, default=20.0,
+                   help="open-loop: Poisson arrival rate, req/s")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--precision", default="bf16", choices=["bf16", "fp32"])
+    p.add_argument("--iters", type=int, default=32)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--max-queue", type=int, default=256)
+    p.add_argument("--batch-sizes", default=None,
+                   help="comma-separated compiled batch sizes")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="include first-request compiles in the "
+                        "measurement (cold-start experiment)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    if args.tiny:
+        args.small = True
+        args.precision = "fp32"
+        args.iters = 2
+        args.shapes = "64x96,36x52"
+        args.requests = 24
+        args.concurrency = 4
+        args.rate = 40.0
+        args.max_batch = 4
+        args.batch_sizes = args.batch_sizes or "4"
+        args.max_wait_ms = 10.0
+        args.max_queue = 64
+    return args
+
+
+def _run_closed(engine, pairs, n_requests, concurrency):
+    """Each worker keeps one request in flight; returns elapsed seconds."""
+    next_i = [0]
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                i = next_i[0]
+                if i >= n_requests:
+                    return
+                next_i[0] += 1
+            im1, im2 = pairs[i % len(pairs)]
+            engine.infer(im1, im2)
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, 0
+
+
+def _run_open(engine, pairs, n_requests, rate, rng):
+    """Poisson arrivals at ``rate`` req/s; returns (elapsed, rejected).
+
+    Arrivals keep coming while earlier requests run — rejected submits
+    (429 backpressure) are counted, not retried (a shed request's work
+    is the balancer's problem, not this chip's)."""
+    from raft_tpu.serve import QueueFullError
+
+    futures, rejected = [], 0
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        time.sleep(rng.exponential(1.0 / rate))
+        im1, im2 = pairs[i % len(pairs)]
+        try:
+            futures.append(engine.submit(im1, im2))
+        except QueueFullError:
+            rejected += 1
+    for f in futures:
+        f.result()
+    return time.perf_counter() - t0, rejected
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.serve import InferenceEngine, ServeConfig
+
+    mk = RAFTConfig.small_model if args.small else RAFTConfig.full
+    model_cfg = mk(compute_dtype="bfloat16" if args.precision == "bf16"
+                   else "float32")
+    model = RAFT(model_cfg)
+    key = jax.random.PRNGKey(args.seed)
+    img = jax.numpy.zeros((1, 64, 96, 3))
+    variables = jax.jit(
+        lambda k: model.init({"params": k, "dropout": k}, img, img,
+                             iters=2, train=False))(key)
+
+    shapes = []
+    for tok in args.shapes.split(","):
+        h, w = tok.strip().lower().split("x")
+        shapes.append((int(h), int(w)))
+    rng = np.random.default_rng(args.seed)
+    pairs = [(rng.uniform(0, 255, (h, w, 3)).astype(np.float32),
+              rng.uniform(0, 255, (h, w, 3)).astype(np.float32))
+             for (h, w) in shapes]
+
+    serve_cfg = ServeConfig(
+        iters=args.iters, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        batch_sizes=tuple(int(b) for b in args.batch_sizes.split(","))
+        if args.batch_sizes else None)
+    engine = InferenceEngine(variables, model_cfg, serve_cfg)
+    engine.start()
+    try:
+        if not args.no_warmup:
+            engine.warmup(shapes)
+        if args.mode == "closed":
+            assert args.concurrency <= args.max_queue, \
+                "closed loop would trip its own backpressure"
+            dt, rejected = _run_closed(engine, pairs, args.requests,
+                                       args.concurrency)
+        else:
+            dt, rejected = _run_open(engine, pairs, args.requests,
+                                     args.rate, rng)
+        stats = engine.stats()
+    finally:
+        engine.stop()
+
+    n_dev = max(jax.local_device_count(), 1)
+    completed = args.requests - rejected
+    pairs_per_sec_per_chip = completed / dt / n_dev
+    tag = "tiny" if args.tiny else "+".join(f"{h}x{w}"
+                                            for (h, w) in shapes)
+    load = (f"c{args.concurrency}" if args.mode == "closed"
+            else f"r{args.rate:g}")
+    print(json.dumps({
+        "metric": f"serve_{args.mode}loop_{tag}_{load}_iters{args.iters}",
+        "value": round(pairs_per_sec_per_chip, 3),
+        "unit": "image-pairs/sec/chip",
+        "vs_baseline": 0.0,
+        "latency_ms": stats["latency_ms"],
+        "rejected": rejected,
+        "occupancy": stats["occupancy"],
+        "compiles": stats["compiles"],
+        "config": {"mode": args.mode, "requests": args.requests,
+                   "concurrency": args.concurrency, "rate": args.rate,
+                   "shapes": args.shapes, "iters": args.iters,
+                   "max_batch": args.max_batch,
+                   "max_wait_ms": args.max_wait_ms,
+                   "max_queue": args.max_queue,
+                   "batch_sizes": args.batch_sizes,
+                   "warmup": not args.no_warmup,
+                   "precision": args.precision, "small": args.small},
+    }))
+
+
+if __name__ == "__main__":
+    main()

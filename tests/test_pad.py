@@ -1,10 +1,13 @@
-"""InputPadder tests (reference utils.py:7-24 semantics)."""
+"""InputPadder tests (reference utils.py:7-24 semantics) + the shared
+bucket policy (eval validators and the serve engine both round through
+raft_tpu.ops.pad, so they cannot drift)."""
 
 import numpy as np
 
 import jax.numpy as jnp
 
-from raft_tpu.ops import InputPadder
+from raft_tpu.ops import InputPadder, bucket_hw, ceil_to_multiple, \
+    max_bucket_hw
 
 
 def test_pad_to_multiple_of_8_sintel_centered():
@@ -34,3 +37,38 @@ def test_already_divisible_no_pad():
     padder = InputPadder(x.shape)
     y = padder.pad(x)
     assert y.shape == x.shape
+
+
+def test_ceil_to_multiple():
+    assert ceil_to_multiple(436) == 440
+    assert ceil_to_multiple(440) == 440
+    assert ceil_to_multiple(1, 8) == 8
+    assert ceil_to_multiple(370, 2) == 370
+
+
+def test_bucket_hw_exact_roundup():
+    assert bucket_hw(436, 1024) == (440, 1024)
+    assert bucket_hw(375, 1242) == (376, 1248)
+    assert bucket_hw(64, 96) == (64, 96)
+
+
+def test_bucket_hw_ladder():
+    ladder = ((440, 1024), (720, 1280))
+    # smallest covering ladder entry wins
+    assert bucket_hw(436, 1024, ladder=ladder) == (440, 1024)
+    assert bucket_hw(441, 1024, ladder=ladder) == (720, 1280)
+    # larger than every entry: exact round-up fallback, still served
+    assert bucket_hw(1440, 2560, ladder=ladder) == (1440, 2560)
+
+
+def test_max_bucket_hw_matches_padder_targets():
+    """The validators' one-bucket-per-split policy: every shape in the
+    set fits the bucket, and the bucket is the tight /8 round-up of the
+    max (KITTI's mixed native resolutions)."""
+    shapes = [(375, 1242), (370, 1224), (374, 1238)]
+    bucket = max_bucket_hw(shapes)
+    assert bucket == (376, 1248)
+    for hw in shapes:
+        padder = InputPadder(hw, mode="kitti", target=bucket)
+        x = np.zeros(hw + (3,), np.float32)
+        assert padder.pad_np(x).shape == bucket + (3,)

@@ -1,8 +1,19 @@
-"""Unit tests for the HBM-limit artifact loader (no device work)."""
+"""Unit tests for the profiling utilities: HBM-limit artifact loader,
+allocation-probe error classification, per-user persistent compile
+cache, and the serve engine's compile-count ledger (no device work)."""
 
 import json
+import os
+import os.path as osp
+import stat
 
-from raft_tpu.utils.profiling import load_hbm_limit
+from raft_tpu.utils.profiling import (
+    CompileCounter,
+    default_compile_cache_dir,
+    enable_persistent_compile_cache,
+    load_hbm_limit,
+    probe_error_is_oom,
+)
 
 
 def test_load_hbm_limit_valid(tmp_path):
@@ -32,3 +43,61 @@ def test_load_hbm_limit_corrupt_and_degenerate(tmp_path):
     # sub-GB degenerate value -> fallback (probe guard mirrored here).
     p.write_text(json.dumps({"hbm_limit_gb": 0.25}))
     assert load_hbm_limit(16.0, path=str(p))[0] == 16.0
+
+
+def test_probe_error_classification():
+    """Only OOM-shaped failures may terminate the allocation probe as a
+    measurement; transport/backend errors are a broken probe."""
+    assert probe_error_is_oom(
+        RuntimeError("RESOURCE_EXHAUSTED: attempting to allocate ..."))
+    assert probe_error_is_oom(
+        RuntimeError("Resource exhausted: Out of memory while trying"))
+    assert probe_error_is_oom(ValueError("TPU OOM allocating 256 MiB"))
+    assert not probe_error_is_oom(
+        RuntimeError("DEADLINE_EXCEEDED: socket closed"))
+    assert not probe_error_is_oom(
+        ConnectionError("relay tunnel reset by peer"))
+    assert not probe_error_is_oom(RuntimeError("INTERNAL: mesh barrier"))
+
+
+def test_default_cache_dir_is_per_user(monkeypatch):
+    monkeypatch.delenv("RAFT_JAX_CACHE_DIR", raising=False)
+    d = default_compile_cache_dir()
+    base = osp.basename(d)
+    assert base.startswith("raft_jaxcache-") and base != "raft_jaxcache"
+    uid = getattr(os, "getuid", lambda: None)()
+    if uid is not None:  # posix: uid embedded -> no cross-user collision
+        assert str(uid) in base
+    monkeypatch.setenv("RAFT_JAX_CACHE_DIR", "/somewhere/else")
+    assert default_compile_cache_dir() == "/somewhere/else"
+
+
+def test_enable_persistent_cache_creates_0700(tmp_path, monkeypatch):
+    import jax
+
+    target = tmp_path / "jaxcache"
+    monkeypatch.setenv("RAFT_JAX_CACHE_DIR", str(target))
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        assert enable_persistent_compile_cache() == str(target)
+        assert stat.S_IMODE(os.stat(target).st_mode) == 0o700
+        assert jax.config.jax_compilation_cache_dir == str(target)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          old_min)
+
+
+def test_compile_counter():
+    c = CompileCounter()
+    key = ((440, 1024), 8)
+    assert c.count(key) == 0 and c.total() == 0
+    c.record(key)
+    c.record(((376, 1248), 4))
+    c.record(key)
+    assert c.count(key) == 2
+    assert c.counts() == {key: 2, ((376, 1248), 4): 1}
+    assert c.total() == 3
+    c.reset()
+    assert c.counts() == {}
