@@ -32,6 +32,11 @@ def parse_args(argv=None):
                    help="KITTI: exact reference per-resolution padding "
                         "(one XLA compile per distinct image shape) "
                         "instead of one common bucket shape")
+    p.add_argument("--telemetry_dir", "--telemetry-dir", default=None,
+                   help="write JSONL telemetry events (per-batch forward "
+                        "spans, final eval record) into this directory; "
+                        "defaults to $RAFT_TELEMETRY_DIR, unset = "
+                        "disabled")
     return p.parse_args(argv)
 
 
@@ -59,7 +64,16 @@ def load_model_variables(path: str):
 def main(argv=None):
     args = parse_args(argv)
 
+    import os
     import os.path as osp
+
+    if args.telemetry_dir:
+        # The eval spans write through the process-default sink, which
+        # binds to this env var on first use (raft_tpu/obs/events.py).
+        os.environ["RAFT_TELEMETRY_DIR"] = args.telemetry_dir
+        from raft_tpu.obs import reset_default_sink
+
+        reset_default_sink()
 
     from raft_tpu import evaluate
     from raft_tpu.config import RAFTConfig

@@ -12,7 +12,11 @@ flow is float32 and PNG-style encodings lose the sign/scale):
   queue is full (shed load, retry with backoff); 400 on malformed input.
 - ``GET /v1/stats``  JSON engine snapshot (latency percentiles,
   pairs/sec/chip, per-bucket compile counts).
-- ``GET /healthz``   200 once the engine accepts traffic.
+- ``GET /metrics``   Prometheus text exposition rendered from the same
+  engine registry ``/v1/stats`` reads (docs/OBSERVABILITY.md has the
+  metric catalog) — point a Prometheus scrape job here.
+- ``GET /v1/healthz`` (alias ``/healthz``)  200 once the engine accepts
+  traffic.
 
 Example client::
 
@@ -72,6 +76,10 @@ def parse_args(argv=None):
     p.add_argument("--warmup", default=None,
                    help="comma-separated HxW image shapes to pre-compile "
                         "before accepting traffic")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="write JSONL telemetry events (per-batch "
+                        "records) into this directory; defaults to "
+                        "$RAFT_TELEMETRY_DIR, unset = disabled")
     return p.parse_args(argv)
 
 
@@ -108,10 +116,15 @@ def _make_handler(engine):
                         "application/json", extra)
 
         def do_GET(self):
-            if self.path == "/healthz":
+            if self.path in ("/healthz", "/v1/healthz"):
                 self._reply(200, b"ok", "text/plain")
             elif self.path == "/v1/stats":
                 self._reply_json(200, engine.stats())
+            elif self.path == "/metrics":
+                from raft_tpu.obs import PROMETHEUS_CONTENT_TYPE
+
+                self._reply(200, engine.metrics_text().encode(),
+                            PROMETHEUS_CONTENT_TYPE)
             else:
                 self._reply_json(404, {"error": f"no route {self.path}"})
 
@@ -185,7 +198,12 @@ def main(argv=None):
         buckets=_parse_hw_list(args.buckets) if args.buckets else None,
         batch_sizes=tuple(int(b) for b in args.batch_sizes.split(","))
         if args.batch_sizes else None)
-    engine = InferenceEngine(variables, model_cfg, serve_cfg)
+    sink = None
+    if args.telemetry_dir:
+        from raft_tpu.obs import EventSink
+
+        sink = EventSink(args.telemetry_dir)
+    engine = InferenceEngine(variables, model_cfg, serve_cfg, sink=sink)
     engine.start()
     if args.warmup:
         shapes = _parse_hw_list(args.warmup)

@@ -101,6 +101,12 @@ def parse_args(argv=None):
     p.add_argument("--profile_dir", default=None,
                    help="capture a jax.profiler trace of a few steps "
                         "into this directory (view with XProf/TB)")
+    p.add_argument("--telemetry_dir", "--telemetry-dir", default=None,
+                   help="write per-step JSONL telemetry (step_time_s, "
+                        "data_wait_s, pairs/sec/chip, compile + hbm "
+                        "events; docs/OBSERVABILITY.md) into this "
+                        "directory; defaults to $RAFT_TELEMETRY_DIR, "
+                        "unset = disabled")
     p.add_argument("--num_workers", type=int, default=0,
                    help="loader prefetch threads; 0 = min(16, cpu_count) "
                         "(the native augmentation kernels release the "
@@ -152,6 +158,17 @@ def main(argv=None):
         # Must run before any backend initialization; every host then sees
         # the same global device mesh and feeds its own batch stride
         # (ShardedLoader host_id below).
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # Multi-process CPU "pods" (CI, local rehearsal of the pod
+            # flow) need an explicit collectives backend on jaxlib >=
+            # 0.4.34 — without it jitted collectives die with
+            # "Multiprocess computations aren't implemented on the CPU
+            # backend".  Gloo ships in the wheel.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:  # older jax: flag absent, CPU built in
+                pass
         jax.distributed.initialize()
 
     from raft_tpu import evaluate
@@ -279,8 +296,8 @@ def main(argv=None):
 
     train(model_cfg, cfg, loader=loader, validators=validators or None,
           restore_params=restore, tensorboard_dir=args.tensorboard_dir,
-          profile_dir=args.profile_dir, mesh=mesh,
-          shard_spatial=args.shard_spatial > 1)
+          profile_dir=args.profile_dir, telemetry_dir=args.telemetry_dir,
+          mesh=mesh, shard_spatial=args.shard_spatial > 1)
 
 
 if __name__ == "__main__":

@@ -32,6 +32,7 @@ import numpy as np
 from raft_tpu.config import RAFTConfig
 from raft_tpu.data import datasets, frame_utils
 from raft_tpu.models.raft import RAFT
+from raft_tpu.obs import default_sink, span
 from raft_tpu.ops.pad import InputPadder, max_bucket_hw
 from raft_tpu.utils.warp import forward_interpolate
 
@@ -151,17 +152,23 @@ def _batched_flows(variables, eval_fn, ds, mode: str, batch_size: int,
     for start in range(0, n, batch_size):
         idxs = list(range(start, min(start + batch_size, n)))
         samples = [ds.load(i) for i in idxs]
-        padders = [InputPadder(s["image1"].shape, mode=mode, target=target)
-                   for s in samples]
-        im1 = [p.pad_np(s["image1"]) for p, s in zip(padders, samples)]
-        im2 = [p.pad_np(s["image2"]) for p, s in zip(padders, samples)]
-        pad_n = batch_size - len(idxs)
-        if pad_n:  # keep the compiled batch shape on the final chunk
-            im1 += [im1[-1]] * pad_n
-            im2 += [im2[-1]] * pad_n
-        _, flow_up = eval_fn(variables, jnp.asarray(np.stack(im1)),
-                             jnp.asarray(np.stack(im2)))
-        flow_up = np.asarray(flow_up)
+        with span("raft_eval_pad", dataset=mode):
+            padders = [InputPadder(s["image1"].shape, mode=mode,
+                                   target=target) for s in samples]
+            im1 = [p.pad_np(s["image1"]) for p, s in zip(padders, samples)]
+            im2 = [p.pad_np(s["image2"]) for p, s in zip(padders, samples)]
+            pad_n = batch_size - len(idxs)
+            if pad_n:  # keep the compiled batch shape on the final chunk
+                im1 += [im1[-1]] * pad_n
+                im2 += [im2[-1]] * pad_n
+            batch1 = jnp.asarray(np.stack(im1))
+            batch2 = jnp.asarray(np.stack(im2))
+        # The forward span covers dispatch AND the host transfer below,
+        # so it measures real device time per batch (one event per
+        # batch in the JSONL log when telemetry is enabled).
+        with span("raft_eval_forward", dataset=mode, emit=True):
+            _, flow_up = eval_fn(variables, batch1, batch2)
+            flow_up = np.asarray(flow_up)
         for j, (s, p) in enumerate(zip(samples, padders)):
             yield s, np.asarray(p.unpad(flow_up[j:j + 1])[0])
 
@@ -181,10 +188,12 @@ def validate_chairs(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
     epe_list = []
     for sample, flow in _batched_flows(variables, eval_fn, ds, "chairs",
                                        batch_size):
-        epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
-        epe_list.append(epe.reshape(-1))
+        with span("raft_eval_epe", dataset="chairs"):
+            epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
+            epe_list.append(epe.reshape(-1))
     epe = float(np.mean(np.concatenate(epe_list)))
     print(f"Validation Chairs EPE: {epe:.3f}", flush=True)
+    default_sink().emit("eval", dataset="chairs", chairs=epe)
     return {"chairs": epe}
 
 
@@ -203,8 +212,10 @@ def validate_sintel(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
         for sample, flow in _batched_flows(variables, eval_fn, ds,
                                            "sintel", batch_size,
                                            target=_bucket_hw(ds)):
-            epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
-            epe_list.append(epe.reshape(-1))
+            with span("raft_eval_epe", dataset="sintel"):
+                epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2,
+                                     axis=-1))
+                epe_list.append(epe.reshape(-1))
         epe_all = np.concatenate(epe_list)
         epe = float(np.mean(epe_all))
         px1 = float(np.mean(epe_all < 1))
@@ -212,6 +223,8 @@ def validate_sintel(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
         px5 = float(np.mean(epe_all < 5))
         print(f"Validation ({dstype}) EPE: {epe:.3f}, 1px: {px1:.3f}, "
               f"3px: {px3:.3f}, 5px: {px5:.3f}", flush=True)
+        default_sink().emit("eval", dataset=f"sintel-{dstype}", epe=epe,
+                            px1=px1, px3=px3, px5=px5)
         results[dstype] = epe
     return results
 
@@ -233,15 +246,17 @@ def validate_kitti(variables, model_cfg: RAFTConfig = RAFTConfig.full(),
     epe_list, out_list = [], []
     for sample, flow in _batched_flows(variables, eval_fn, ds, "kitti",
                                        bs, target=target):
-        epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
-        mag = np.sqrt(np.sum(sample["flow"] ** 2, axis=-1))
-        val = sample["valid"] >= 0.5
-        out = (epe > 3.0) & ((epe / np.maximum(mag, 1e-12)) > 0.05)
-        epe_list.append(epe[val].mean())
-        out_list.append(out[val])
+        with span("raft_eval_epe", dataset="kitti"):
+            epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
+            mag = np.sqrt(np.sum(sample["flow"] ** 2, axis=-1))
+            val = sample["valid"] >= 0.5
+            out = (epe > 3.0) & ((epe / np.maximum(mag, 1e-12)) > 0.05)
+            epe_list.append(epe[val].mean())
+            out_list.append(out[val])
     epe = float(np.mean(epe_list))
     f1 = 100.0 * float(np.mean(np.concatenate(out_list)))
     print(f"Validation KITTI: {epe:.3f}, {f1:.3f}", flush=True)
+    default_sink().emit("eval", dataset="kitti", epe=epe, f1=f1)
     return {"kitti-epe": epe, "kitti-f1": f1}
 
 

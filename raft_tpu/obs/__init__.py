@@ -1,0 +1,45 @@
+"""Unified telemetry layer (docs/OBSERVABILITY.md).
+
+Three composable pieces, shared by train/eval/serve:
+
+- :class:`MetricRegistry` — thread-safe counters / gauges / histograms
+  (bounded reservoirs), renderable as Prometheus text exposition
+  (``GET /metrics`` on the serving CLI).
+- :func:`span` — time a block into a histogram, optionally emitting a
+  JSONL event.
+- :class:`EventSink` — structured JSONL event log under
+  ``RAFT_TELEMETRY_DIR`` (or ``--telemetry-dir``); one record per
+  event with wall+monotonic timestamps, step, and process index.
+  ``scripts/telemetry_summary.py`` folds a log into bench.py JSON.
+
+Hot-path contract: recording is lock-cheap, never forces a device
+sync, and the whole layer is a no-op when disabled.
+"""
+
+from raft_tpu.obs.events import (
+    EventSink,
+    default_sink,
+    reset_default_sink,
+)
+from raft_tpu.obs.exposition import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
+from raft_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    default_registry,
+    span,
+)
+
+__all__ = [
+    "Counter",
+    "EventSink",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "PROMETHEUS_CONTENT_TYPE",
+    "default_registry",
+    "default_sink",
+    "reset_default_sink",
+    "span",
+]

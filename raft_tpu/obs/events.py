@@ -1,0 +1,122 @@
+"""Structured JSONL event log (the telemetry stream spans fold into).
+
+One record per event, one line per record, append-only::
+
+    {"event": "train_step", "t_wall": 1722777600.123,
+     "t_mono": 123.456, "process": 0, "step": 42,
+     "step_time_s": 0.51, "data_wait_s": 0.002, ...}
+
+- ``t_wall`` is ``time.time()`` (correlate across hosts / with XProf
+  traces); ``t_mono`` is ``time.perf_counter()`` (durations within one
+  process — wall clocks step, monotonic ones don't).
+- ``process`` is ``jax.process_index()`` (0 when the backend is not
+  initialized), and each process writes its own
+  ``telemetry-p<index>.jsonl`` so pod runs never interleave writers.
+- Disabled (no directory, and ``RAFT_TELEMETRY_DIR`` unset) the sink is
+  a no-op: ``emit`` returns before building the record.
+
+The file is opened line-buffered, so every record is one ``write``
+syscall and a crashed run keeps everything up to its last event —
+microseconds per event, never a device sync.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class EventSink:
+    """Append-only JSONL writer; thread-safe; no-op when ``directory``
+    is None."""
+
+    def __init__(self, directory: Optional[str] = None, *,
+                 filename: Optional[str] = None):
+        self._dir = directory or None
+        self._filename = filename
+        self._lock = threading.Lock()
+        self._fh = None
+        self._process: Optional[int] = None
+        self.path: Optional[str] = None
+
+    @classmethod
+    def from_env(cls) -> "EventSink":
+        return cls(os.environ.get("RAFT_TELEMETRY_DIR") or None)
+
+    @property
+    def enabled(self) -> bool:
+        return self._dir is not None
+
+    def _ensure_open(self):
+        if self._fh is None:
+            os.makedirs(self._dir, exist_ok=True)
+            self._process = _process_index()
+            name = self._filename or f"telemetry-p{self._process}.jsonl"
+            self.path = os.path.join(self._dir, name)
+            self._fh = open(self.path, "a", buffering=1)
+        return self._fh
+
+    def emit(self, event: str, step: Optional[int] = None,
+             **fields) -> None:
+        """Write one event record.  ``fields`` must be JSON-able (or
+        str()-able — ``default=str`` keeps a stray numpy scalar from
+        killing the run that merely wanted telemetry)."""
+        if self._dir is None:
+            return
+        with self._lock:
+            fh = self._ensure_open()
+            rec = {"event": event, "t_wall": time.time(),
+                   "t_mono": time.perf_counter(),
+                   "process": self._process}
+            if step is not None:
+                rec["step"] = int(step)
+            rec.update(fields)
+            fh.write(json.dumps(rec, default=str) + "\n")
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+_default_sink: Optional[EventSink] = None
+_default_lock = threading.Lock()
+
+
+def default_sink() -> EventSink:
+    """Process-wide sink bound to ``RAFT_TELEMETRY_DIR`` at first use
+    (no-op when unset).  CLIs that take ``--telemetry-dir`` export the
+    env var before anything touches telemetry, so this picks it up."""
+    global _default_sink
+    if _default_sink is None:
+        with _default_lock:
+            if _default_sink is None:
+                _default_sink = EventSink.from_env()
+    return _default_sink
+
+
+def reset_default_sink() -> None:
+    """Close and forget the default sink (tests; env changes)."""
+    global _default_sink
+    with _default_lock:
+        if _default_sink is not None:
+            _default_sink.close()
+        _default_sink = None

@@ -1,0 +1,115 @@
+"""Training-loop telemetry recorder (driven by ``raft_tpu/train/loop.py``).
+
+Everything this class receives is a host-side float the loop measured
+with ``perf_counter`` — it never sees the step's device arrays, so by
+construction it cannot add a device sync to the step path (the
+``Logger`` keeps its once-per-interval transfer; tests assert the
+cadence is unchanged with telemetry on).
+
+Per step it records/emits:
+
+- ``step_time_s``: wall time of the whole loop iteration (fetch +
+  host-side prep + dispatch).  Dispatch is async, so once the pipeline
+  fills, host iteration time converges to device step time.
+- ``data_wait_s``: time blocked in ``next()`` on the input iterator —
+  the input-bound detector.  ``data_wait_s/step_time_s`` near 1 on a
+  v5e means the chips are starving and the loader needs workers, not
+  the model an optimizer.
+- ``pairs_per_sec_per_chip``: ``batch / step_time / num_devices`` — the
+  BASELINE.json north-star metric as a continuously measured number.
+
+One-time events: ``run_config`` (what scripts/telemetry_summary.py
+needs to fold the log into bench.py JSON), ``compile`` (the first
+executed step's dispatch time, which is dominated by trace+compile; the
+:class:`~raft_tpu.utils.profiling.CompileCounter` is wired into the
+registry), and ``hbm_usage`` (XLA memory analysis of the compiled step;
+costs one extra ``lower().compile()`` at startup, disable with
+``RAFT_TELEMETRY_HBM=0``).  ``close()`` emits a ``metrics_summary``
+with the full registry snapshot so a run's aggregates survive in the
+same JSONL file as its per-step stream.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from raft_tpu.obs.events import EventSink
+from raft_tpu.obs.registry import MetricRegistry
+from raft_tpu.utils.profiling import CompileCounter
+
+
+class TrainTelemetry:
+    def __init__(self, directory: Optional[str] = None, *,
+                 batch_size: int, num_devices: int,
+                 image_size: Tuple[int, int],
+                 registry: Optional[MetricRegistry] = None,
+                 hbm: Optional[bool] = None):
+        directory = directory or os.environ.get("RAFT_TELEMETRY_DIR") or None
+        self.sink = EventSink(directory)
+        self.enabled = self.sink.enabled
+        self.registry = registry or MetricRegistry(enabled=self.enabled)
+        self.batch_size = int(batch_size)
+        self.num_devices = max(int(num_devices), 1)
+        self.image_size = tuple(int(x) for x in image_size)
+        if hbm is None:
+            hbm = os.environ.get("RAFT_TELEMETRY_HBM", "1") == "1"
+        self.hbm_enabled = self.enabled and hbm
+        self.compile_counter = CompileCounter(
+            registry=self.registry, metric="raft_train_compiles_total")
+        self._step_hist = self.registry.histogram(
+            "raft_train_step_seconds", "wall time per training step")
+        self._wait_hist = self.registry.histogram(
+            "raft_train_data_wait_seconds",
+            "time blocked on the input iterator per step")
+        self._pps = self.registry.gauge(
+            "raft_train_pairs_per_sec_per_chip",
+            "batch / step_time / num_devices, last step")
+
+    def start(self, start_step: int, num_steps: int) -> None:
+        if not self.enabled:
+            return
+        self.sink.emit("run_config", step=start_step,
+                       batch_size=self.batch_size,
+                       num_devices=self.num_devices,
+                       image_size=list(self.image_size),
+                       num_steps=int(num_steps))
+
+    def record_step(self, step: int, step_time_s: float,
+                    data_wait_s: float) -> None:
+        if not self.enabled:
+            return
+        pps = (self.batch_size / step_time_s / self.num_devices
+               if step_time_s > 0 else 0.0)
+        self._step_hist.observe(step_time_s)
+        self._wait_hist.observe(data_wait_s)
+        self._pps.set(pps)
+        self.sink.emit("train_step", step=step,
+                       step_time_s=round(step_time_s, 6),
+                       data_wait_s=round(data_wait_s, 6),
+                       pairs_per_sec_per_chip=round(pps, 3))
+
+    def record_compile(self, step: int, seconds: float, key) -> None:
+        """First dispatch of a jitted step signature: trace+compile
+        dominates its wall time, so that is the recorded figure."""
+        if not self.enabled:
+            return
+        self.compile_counter.record(key)
+        self.sink.emit("compile", step=step, key=str(key),
+                       seconds=round(seconds, 6))
+
+    def record_hbm(self, info: dict) -> None:
+        if not self.enabled:
+            return
+        peak = info.get("peak_hbm_gb")
+        if isinstance(peak, (int, float)):
+            self.registry.gauge(
+                "raft_train_peak_hbm_gb",
+                "compiled step's XLA peak device allocation").set(peak)
+        self.sink.emit("hbm_usage", **info)
+
+    def close(self) -> None:
+        if self.enabled:
+            self.sink.emit("metrics_summary",
+                           metrics=self.registry.snapshot())
+        self.sink.close()

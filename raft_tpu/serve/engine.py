@@ -59,6 +59,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.config import RAFTConfig
+from raft_tpu.obs import EventSink, MetricRegistry
 from raft_tpu.ops.pad import InputPadder, bucket_hw
 from raft_tpu.serve.stats import Counters, LatencyRecorder
 from raft_tpu.utils.profiling import CompileCounter
@@ -145,7 +146,9 @@ class InferenceEngine:
     """
 
     def __init__(self, variables, model_cfg: RAFTConfig,
-                 cfg: ServeConfig = ServeConfig()):
+                 cfg: ServeConfig = ServeConfig(), *,
+                 registry: Optional[MetricRegistry] = None,
+                 sink: Optional[EventSink] = None):
         # Deferred import: evaluate.py pulls the dataset stack, and the
         # dependency is one function (the shared inference overrides).
         from raft_tpu.evaluate import make_inference_model
@@ -163,10 +166,22 @@ class InferenceEngine:
 
         self._executables: Dict[tuple, object] = {}
         self._compile_lock = threading.Lock()
-        self.compile_counter = CompileCounter()
+        # One registry per engine: every stats/exposition figure below
+        # reads these same metric objects (see serve/stats.py), and
+        # cli/serve.py renders them at GET /metrics.
+        self.registry = registry or MetricRegistry()
+        self._sink = sink if sink is not None else EventSink.from_env()
+        self.compile_counter = CompileCounter(
+            registry=self.registry, metric="raft_serve_compiles_total",
+            labeler=lambda key: {"bucket": f"{key[0][0]}x{key[0][1]}",
+                                 "batch": str(key[1])})
 
-        self._latency = LatencyRecorder(cfg.latency_window)
-        self._counters = Counters()
+        self._latency = LatencyRecorder(cfg.latency_window,
+                                        registry=self.registry)
+        self._counters = Counters(registry=self.registry)
+        self._pending_gauge = self.registry.gauge(
+            "raft_serve_pending_requests", "requests in flight")
+        self.registry.add_collect_hook(self._collect_pending)
 
         self._pending = 0
         self._pending_lock = threading.Lock()
@@ -297,6 +312,17 @@ class InferenceEngine:
                 keys.append((bucket, int(bs)))
         return keys
 
+    def _collect_pending(self, _reg) -> None:
+        with self._pending_lock:
+            pending = self._pending
+        self._pending_gauge.set(pending)
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the engine registry (the same
+        counters/histograms ``stats()`` reads — the surfaces cannot
+        drift)."""
+        return self.registry.render_prometheus()
+
     def stats(self) -> dict:
         """One JSON-able snapshot: counters, latency percentiles over the
         recent window, per-``(bucket, batch)`` compile counts."""
@@ -380,9 +406,10 @@ class InferenceEngine:
         return exe
 
     def _run_batch(self, bucket: tuple, reqs: List[_Request]) -> None:
+        n = len(reqs)
+        bs = next((s for s in self._batch_sizes if s >= n), n)
+        t_start = time.perf_counter()
         try:
-            n = len(reqs)
-            bs = next(s for s in self._batch_sizes if s >= n)
             exe = self._get_executable(bucket, bs)
             im1 = [r.padder.pad_np(r.image1) for r in reqs]
             im2 = [r.padder.pad_np(r.image2) for r in reqs]
@@ -397,11 +424,21 @@ class InferenceEngine:
                     np.asarray(r.padder.unpad(flow_up[j:j + 1])[0]))
                 self._latency.record(t_done - r.t_submit)
             self._counters.add_batch(real=n, padded=bs - n, failed=False)
+            self._sink.emit("serve_batch",
+                            bucket=f"{bucket[0]}x{bucket[1]}", real=n,
+                            ballast=bs - n,
+                            seconds=round(t_done - t_start, 6))
         except Exception as e:
             for r in reqs:
                 if not r.future.done():
                     r.future.set_exception(e)
-            self._counters.add_batch(real=0, padded=0, failed=True)
+            # The batch's REAL lanes must stay in the lane accounting
+            # (as failed_lanes) or occupancy/mean_batch_fill read too
+            # healthy under errors — see Counters.add_batch.
+            self._counters.add_batch(real=n, padded=bs - n, failed=True)
+            self._sink.emit("serve_batch_error",
+                            bucket=f"{bucket[0]}x{bucket[1]}", real=n,
+                            error=f"{type(e).__name__}: {e}")
         finally:
             with self._pending_lock:
                 self._pending -= len(reqs)

@@ -1,50 +1,70 @@
 """Serving metrics: latency percentiles + throughput counters.
 
+Both classes are thin views over ``raft_tpu.obs.MetricRegistry``
+metrics: every figure the JSON ``/v1/stats`` snapshot reports is
+derived from the same registry counters/histograms the Prometheus
+``GET /metrics`` endpoint renders, so the two surfaces cannot drift
+(they can only be read microseconds apart — per-metric locks, no
+cross-metric atomic snapshot, which is fine for monitoring).
+
 The engine records one latency sample per completed request (submit ->
 result, i.e. including queueing and batching delay — the number a client
-actually experiences) into a bounded ring, so a long-running server's
-``stats()`` reflects *recent* traffic and memory stays O(window).
-Percentiles are computed on snapshot, not on record: the record path is
-on the request hot path, the snapshot path is a human asking.
+actually experiences) into a bounded reservoir, so a long-running
+server's ``stats()`` reflects *recent* traffic and memory stays
+O(window).  Percentiles are computed on snapshot, not on record: the
+record path is on the request hot path, the snapshot path is a human
+asking.
 """
 
 from __future__ import annotations
 
-import collections
 import threading
 import time
 from typing import Dict, Optional
 
 import numpy as np
 
+from raft_tpu.obs import MetricRegistry
+
 
 class LatencyRecorder:
-    """Bounded ring of per-request latencies with percentile snapshots.
+    """Bounded reservoir of per-request latencies with percentile
+    snapshots, backed by a registry histogram
+    (``raft_serve_request_latency_seconds``).
 
     Thread-safe: requests complete on the device-worker thread while
     ``snapshot`` is called from CLI/HTTP threads."""
 
-    def __init__(self, window: int = 4096):
-        self._lock = threading.Lock()
-        self._ring: collections.deque = collections.deque(maxlen=window)
-        self._count = 0
+    def __init__(self, window: int = 4096,
+                 registry: Optional[MetricRegistry] = None,
+                 metric: str = "raft_serve_request_latency_seconds"):
+        self._hist = (registry or MetricRegistry()).histogram(
+            metric, "client-observed submit->result latency",
+            reservoir=window)
 
     def record(self, seconds: float) -> None:
-        with self._lock:
-            self._ring.append(seconds)
-            self._count += 1
+        self._hist.observe(seconds)
 
     def snapshot(self) -> Dict[str, float]:
-        """``{count, p50_ms, p95_ms, p99_ms, mean_ms}`` over the recent
-        window (``count`` is lifetime; zeros when nothing completed)."""
-        with self._lock:
-            vals = np.asarray(self._ring, dtype=np.float64)
-            count = self._count
-        if vals.size == 0:
-            return {"count": 0, "p50_ms": 0.0, "p95_ms": 0.0,
+        """``{count, count_total, window_count, p50_ms, p95_ms, p99_ms,
+        mean_ms}``.
+
+        ``count_total`` is the LIFETIME number of recorded requests;
+        the percentiles and ``mean_ms`` are computed over the recent
+        bounded window of ``window_count`` samples only (zeros when
+        nothing completed).  ``count`` is a backwards-compat alias for
+        ``count_total`` (older clients of the wire format read it);
+        prefer the explicit names."""
+        count, _total, window = self._hist.collect()
+        if not window:
+            return {"count": count, "count_total": count,
+                    "window_count": 0, "p50_ms": 0.0, "p95_ms": 0.0,
                     "p99_ms": 0.0, "mean_ms": 0.0}
+        vals = np.asarray(window, dtype=np.float64)
         p50, p95, p99 = np.percentile(vals, [50, 95, 99]) * 1e3
         return {"count": count,
+                "count_total": count,
+                "window_count": int(vals.size),
                 "p50_ms": round(float(p50), 3),
                 "p95_ms": round(float(p95), 3),
                 "p99_ms": round(float(p99), 3),
@@ -52,55 +72,82 @@ class LatencyRecorder:
 
 
 class Counters:
-    """Lifetime request/batch counters (lock-shared with the engine).
+    """Lifetime request/batch counters over registry metrics.
 
-    ``padded_lanes`` counts batch lanes filled with repeated ballast to
-    reach a compiled batch size — ``occupancy`` (real / total lanes) is
-    the knob-tuning signal for ``max_wait_ms`` vs ``max_batch``."""
+    Lane accounting: a batch of ``real`` requests compiled at batch
+    size ``real + padded`` contributes ``real`` real lanes and
+    ``padded`` ballast lanes whether it succeeds or fails — a failed
+    batch's real lanes land in ``failed_lanes`` instead of
+    ``completed``, so ``occupancy`` (real / total lanes) and
+    ``mean_batch_fill`` keep describing what the dispatcher packed,
+    not just what happened to succeed (errors no longer make the
+    batching look *healthier*).  ``occupancy`` is the knob-tuning
+    signal for ``max_wait_ms`` vs ``max_batch``; throughput figures
+    (``pairs_per_sec*``) count completed lanes only."""
 
-    def __init__(self) -> None:
+    def __init__(self, registry: Optional[MetricRegistry] = None) -> None:
+        r = registry or MetricRegistry()
+        self._completed = r.counter("raft_serve_pairs_completed_total",
+                                    "successfully served frame pairs")
+        self._rejected = r.counter("raft_serve_requests_rejected_total",
+                                   "backpressure rejections (HTTP 429)")
+        self._errors = r.counter("raft_serve_batch_errors_total",
+                                 "device batches that raised")
+        self._batches = r.counter("raft_serve_batches_total",
+                                  "device batches dispatched")
+        self._ballast = r.counter("raft_serve_lanes_ballast_total",
+                                  "batch lanes filled with repeated "
+                                  "ballast to reach a compiled size")
+        self._failed = r.counter("raft_serve_lanes_failed_total",
+                                 "real lanes lost to failed batches")
+        self._uptime = r.gauge("raft_serve_uptime_seconds",
+                               "seconds since the engine started")
         self._lock = threading.Lock()
-        self.completed = 0
-        self.rejected = 0
-        self.errors = 0
-        self.batches = 0
-        self.padded_lanes = 0
         self._t0: Optional[float] = None
+        r.add_collect_hook(lambda reg: self._uptime.set(self._uptime_s()))
+
+    def _uptime_s(self) -> float:
+        with self._lock:
+            return (time.perf_counter() - self._t0) if self._t0 else 0.0
 
     def mark_started(self) -> None:
         with self._lock:
             self._t0 = time.perf_counter()
 
     def add_rejected(self, n: int = 1) -> None:
-        with self._lock:
-            self.rejected += n
+        self._rejected.inc(n)
 
     def add_batch(self, real: int, padded: int, failed: bool) -> None:
-        with self._lock:
-            self.batches += 1
-            self.padded_lanes += padded
-            if failed:
-                self.errors += 1
-            else:
-                self.completed += real
+        self._batches.inc()
+        self._ballast.inc(padded)
+        if failed:
+            self._errors.inc()
+            self._failed.inc(real)
+        else:
+            self._completed.inc(real)
 
     def snapshot(self, num_chips: int) -> Dict[str, float]:
-        with self._lock:
-            uptime = (time.perf_counter() - self._t0) if self._t0 else 0.0
-            total_lanes = self.completed + self.padded_lanes
-            return {
-                "uptime_s": round(uptime, 3),
-                "completed": self.completed,
-                "rejected": self.rejected,
-                "errors": self.errors,
-                "batches": self.batches,
-                "mean_batch_fill": round(self.completed / self.batches, 3)
-                if self.batches else 0.0,
-                "occupancy": round(self.completed / total_lanes, 3)
-                if total_lanes else 0.0,
-                "pairs_per_sec": round(self.completed / uptime, 3)
+        uptime = self._uptime_s()
+        completed = self._completed.value()
+        failed_lanes = self._failed.value()
+        ballast = self._ballast.value()
+        batches = self._batches.value()
+        real_lanes = completed + failed_lanes
+        total_lanes = real_lanes + ballast
+        return {
+            "uptime_s": round(uptime, 3),
+            "completed": completed,
+            "rejected": self._rejected.value(),
+            "errors": self._errors.value(),
+            "batches": batches,
+            "failed_lanes": failed_lanes,
+            "mean_batch_fill": round(real_lanes / batches, 3)
+            if batches else 0.0,
+            "occupancy": round(real_lanes / total_lanes, 3)
+            if total_lanes else 0.0,
+            "pairs_per_sec": round(completed / uptime, 3)
+            if uptime > 0 else 0.0,
+            "pairs_per_sec_per_chip":
+                round(completed / uptime / num_chips, 3)
                 if uptime > 0 else 0.0,
-                "pairs_per_sec_per_chip":
-                    round(self.completed / uptime / num_chips, 3)
-                    if uptime > 0 else 0.0,
-            }
+        }

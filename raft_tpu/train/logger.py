@@ -26,11 +26,19 @@ class Logger:
     def _ensure_writer(self):
         # Lazily created like the reference (train.py:105-106).
         if self._writer is None and self._tb_dir is not None:
+            tb_dir = self._tb_dir
             try:
                 from torch.utils.tensorboard import SummaryWriter
-                self._writer = SummaryWriter(self._tb_dir)
-            except Exception:
+                self._writer = SummaryWriter(tb_dir)
+            except Exception as e:
+                # Warn ONCE (clearing _tb_dir stops retries): the run
+                # keeps training, but silently losing every curve to a
+                # missing torch install or an unwritable dir is exactly
+                # the kind of misconfiguration someone tails logs for.
                 self._tb_dir = None
+                print(f"WARNING: tensorboard logging to {tb_dir!r} "
+                      f"disabled ({type(e).__name__}: {e}); stdout "
+                      "metrics continue", flush=True)
         return self._writer
 
     def push(self, step: int, metrics: Dict) -> None:

@@ -21,6 +21,7 @@ import numpy as np
 
 from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.models.raft import RAFT
+from raft_tpu.obs.train import TrainTelemetry
 from raft_tpu.parallel import make_mesh, shard_batch
 from raft_tpu.train.checkpoint import CheckpointManager
 from raft_tpu.train.logger import Logger
@@ -28,7 +29,7 @@ from raft_tpu.train.loss import sequence_loss  # noqa: F401 (re-export)
 from raft_tpu.train.optim import make_optimizer, schedule_of
 from raft_tpu.train.state import TrainState
 from raft_tpu.train.step import init_state, make_train_step
-from raft_tpu.utils.profiling import StepProfiler, annotate_step
+from raft_tpu.utils.profiling import StepProfiler, annotate_step, hbm_usage
 
 # Cooperative preemption: a SIGTERM handler (cli/train.py) sets this and
 # the loop exits at the NEXT STEP BOUNDARY — an async exception could
@@ -93,6 +94,7 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
           restore_params=None,
           tensorboard_dir: Optional[str] = None,
           profile_dir: Optional[str] = None,
+          telemetry_dir: Optional[str] = None,
           mesh=None, shard_spatial: bool = False) -> TrainState:
     """Run the full training loop.
 
@@ -108,6 +110,11 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
     ``spatial`` axis (pass a mesh built with ``num_spatial > 1``) — the
     activation/corr-volume sharding path for inputs too large for one
     chip's HBM.
+    ``telemetry_dir``: write per-step JSONL telemetry (``step_time_s``,
+    ``data_wait_s``, ``pairs_per_sec_per_chip``, compile + hbm events —
+    docs/OBSERVABILITY.md) here; defaults to ``$RAFT_TELEMETRY_DIR``,
+    unset = disabled.  All telemetry timing is host-side
+    ``perf_counter`` — it adds NO device sync to the step path.
     """
     assert (batches is None) != (loader is None), \
         "pass exactly one of batches= or loader="
@@ -146,9 +153,24 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
     noise_rng = np.random.default_rng(
         np.random.SeedSequence([cfg.seed + 1, step]))
     profiler = StepProfiler(profile_dir)
+    telem = TrainTelemetry(telemetry_dir, batch_size=cfg.batch_size,
+                           num_devices=max(jax.device_count(), 1),
+                           image_size=cfg.image_size)
+    telem.start(start_step=step, num_steps=cfg.num_steps)
     t0, steps_t0 = time.time(), step
+    first_dispatched = False
+    batch_iter = iter(batches)
     try:
-        for batch in batches:
+        while True:
+            # data_wait_s: time blocked on the input iterator — the
+            # input-bound detector (host perf_counter only; the step
+            # loop stays async).
+            t_iter = time.perf_counter()
+            try:
+                batch = next(batch_iter)
+            except StopIteration:
+                break
+            data_wait_s = time.perf_counter() - t_iter
             if step >= cfg.num_steps:
                 break
             if (jax.process_count() == 1 and _PREEMPT.is_set()) or (
@@ -158,13 +180,32 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             if cfg.add_noise:
                 batch = add_image_noise(noise_rng, batch)
             profiler.maybe_start(step)
+            sharded = shard_batch(batch, mesh, spatial=shard_spatial)
             with annotate_step(step):
-                state, metrics = step_fn(
-                    state, shard_batch(batch, mesh, spatial=shard_spatial),
-                    key)
+                state, metrics = step_fn(state, sharded, key)
             profiler.maybe_stop(step, sync_on=metrics.get("loss"))
             step += 1
             logger.push(step - 1, metrics)
+            # step_time_s covers fetch + host prep + dispatch.  Dispatch
+            # is async, so once the pipeline fills this converges to the
+            # device step time without ever forcing a transfer.
+            step_time_s = time.perf_counter() - t_iter
+            if not first_dispatched:
+                first_dispatched = True
+                # The first dispatch of this signature traces+compiles
+                # synchronously — its wall time IS the compile figure.
+                telem.record_compile(
+                    step - 1, step_time_s,
+                    key=("train_step", tuple(cfg.image_size),
+                         cfg.batch_size))
+                if telem.hbm_enabled:
+                    # XLA memory analysis of the compiled step (one
+                    # extra lower+compile at startup; cheap under the
+                    # persistent compile cache, RAFT_TELEMETRY_HBM=0
+                    # skips it).  Purely host-side, runs once.
+                    telem.record_hbm(hbm_usage(step_fn, state, sharded,
+                                               key))
+            telem.record_step(step - 1, step_time_s, data_wait_s)
 
             # Second preemption check before the (potentially minutes-
             # long) save+validate block, so a SIGTERM during the step
@@ -218,4 +259,5 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
         mgr.close()
         profiler.close()
         logger.close()
+        telem.close()
     return state

@@ -25,15 +25,29 @@ class CompileCounter:
     ``raft_tpu/serve/engine.py``) record one event per executable they
     actually build.  Tests then assert the serving invariant directly:
     steady-state traffic compiles exactly once per key, never per
-    request.  Thread-safe (the engine compiles from worker threads)."""
+    request.  Thread-safe (the engine compiles from worker threads).
 
-    def __init__(self) -> None:
+    Optionally mirrored into a telemetry registry
+    (``raft_tpu.obs.MetricRegistry``, duck-typed so this module stays
+    import-light): pass ``registry`` and events also increment the
+    ``metric`` counter, labeled via ``labeler(key) -> {label: value}``
+    (default: one ``key=str(key)`` label)."""
+
+    def __init__(self, registry=None, metric: str = "raft_compiles_total",
+                 labeler=None) -> None:
         self._lock = threading.Lock()
         self._counts: Dict[Hashable, int] = {}
+        self._metric = (registry.counter(metric, "XLA compile events")
+                        if registry is not None else None)
+        self._labeler = labeler
 
     def record(self, key: Hashable) -> None:
         with self._lock:
             self._counts[key] = self._counts.get(key, 0) + 1
+        if self._metric is not None:
+            labels = (self._labeler(key) if self._labeler
+                      else {"key": str(key)})
+            self._metric.inc(1, **labels)
 
     def count(self, key: Hashable) -> int:
         with self._lock:

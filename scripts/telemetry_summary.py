@@ -1,0 +1,124 @@
+"""Fold a JSONL telemetry log into bench.py-format JSON.
+
+Any instrumented run (``python -m raft_tpu train --telemetry_dir ...``
+or ``RAFT_TELEMETRY_DIR=...``) leaves ``telemetry-p*.jsonl`` files; this
+script turns the per-step ``train_step`` stream of one run into the ONE
+JSON line bench.py prints — same ``metric``/``value``/``unit``/
+``vs_baseline`` schema, same metric-name mapping (imported from
+bench.py, so the series cannot drift) — letting BENCH_* trajectories be
+produced from any real training run instead of only the synthetic
+bench::
+
+    python scripts/telemetry_summary.py runs/telemetry/
+    python scripts/telemetry_summary.py runs/telemetry/telemetry-p0.jsonl
+
+The last ``run_config`` record in the log (and its following
+``train_step`` records) is summarized by default; ``--skip`` drops the
+leading steps, whose wall time is trace+compile, from the steady-state
+figure (the ``compile`` event is in the log if you want that number).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bench import (  # noqa: E402
+    BASELINE_PAIRS_PER_SEC_PER_CHIP,
+    _stage_name,
+    _train_metric_name,
+)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="telemetry JSONL -> bench.py JSON")
+    p.add_argument("path", help="telemetry-*.jsonl file, or a directory "
+                                "of them (a multi-host run's per-process "
+                                "files are merged by step)")
+    p.add_argument("--skip", type=int, default=2,
+                   help="leading steps to drop (compile + pipeline "
+                        "fill); all steps are kept when fewer exist")
+    return p.parse_args(argv)
+
+
+def iter_records(path):
+    files = ([path] if os.path.isfile(path)
+             else sorted(glob.glob(os.path.join(path, "*.jsonl"))))
+    if not files:
+        raise SystemExit(f"no .jsonl telemetry under {path!r}")
+    for fname in files:
+        with open(fname) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except ValueError:
+                    pass  # torn final line of a killed run
+
+
+def last_run(records):
+    """``(run_config, [train_step...])`` of the LAST run in the log
+    (files append across runs; run_config marks each start)."""
+    run_cfg, steps = None, []
+    for rec in records:
+        ev = rec.get("event")
+        if ev == "run_config":
+            run_cfg, steps = rec, []
+        elif ev == "train_step":
+            steps.append(rec)
+    return run_cfg, steps
+
+
+def summarize(run_cfg, steps, skip=2):
+    if run_cfg is None:
+        raise SystemExit("no run_config event in log (telemetry written "
+                         "by an older build?) — cannot recover batch "
+                         "size / device count")
+    if not steps:
+        raise SystemExit("no train_step events in log")
+    steps = sorted(steps, key=lambda r: r.get("step", 0))
+    kept = steps[skip:] if len(steps) > skip else steps
+    batch = run_cfg["batch_size"]
+    n_dev = max(run_cfg.get("num_devices", 1), 1)
+    h, w = run_cfg["image_size"]
+    wall = sum(r["step_time_s"] for r in kept)
+    wait = sum(r["data_wait_s"] for r in kept)
+    value = len(kept) * batch / wall / n_dev if wall > 0 else 0.0
+    vs = (value / BASELINE_PAIRS_PER_SEC_PER_CHIP
+          if _stage_name(h, w) == "flyingchairs" else 0.0)
+    times = sorted(r["step_time_s"] for r in kept)
+    return {
+        "metric": _train_metric_name(h, w),
+        "value": round(value, 3),
+        "unit": "image-pairs/sec/chip",
+        "vs_baseline": round(vs, 3),
+        "config": {
+            "source": "telemetry",
+            "batch_size": batch,
+            "num_devices": n_dev,
+            "image_size": [h, w],
+            "steps_measured": len(kept),
+            "steps_skipped": len(steps) - len(kept),
+            "data_wait_frac": round(wait / wall, 4) if wall > 0 else 0.0,
+            "step_time_p50_s": round(times[len(times) // 2], 6),
+        },
+    }
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    run_cfg, steps = last_run(iter_records(args.path))
+    print(json.dumps(summarize(run_cfg, steps, skip=args.skip)))
+
+
+if __name__ == "__main__":
+    main()

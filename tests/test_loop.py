@@ -35,7 +35,7 @@ def test_add_image_noise_bounds():
     np.testing.assert_array_equal(out["flow"], b["flow"])
 
 
-def test_train_loop_checkpoint_and_resume(tmp_path):
+def test_train_loop_checkpoint_and_resume(tmp_path, monkeypatch):
     mcfg = RAFTConfig.small_model(corr_levels=2, corr_radius=2)
     tcfg = TrainConfig(name="t", lr=1e-4, num_steps=4, batch_size=8,
                        image_size=(32, 32), iters=2, val_freq=2,
@@ -46,10 +46,27 @@ def test_train_loop_checkpoint_and_resume(tmp_path):
         calls.append(1)
         return {"val/metric": 1.0}
 
+    # hbm snapshot would lower+compile the real step a second time;
+    # the fast tier covers the event, this test covers the stream.
+    monkeypatch.setenv("RAFT_TELEMETRY_HBM", "0")
+    tdir = tmp_path / "telemetry"
     state = train(mcfg, tcfg, _batches(10, tcfg),
-                  validators={"fake": fake_validator})
+                  validators={"fake": fake_validator},
+                  telemetry_dir=str(tdir))
     assert int(state.step) == 4
     assert len(calls) == 2  # steps 2 and 4
+
+    # Real-model telemetry end-to-end: per-step JSONL with the
+    # input-bound detector fields, plus one compile event.
+    import json
+
+    (f,) = tdir.glob("telemetry-p*.jsonl")
+    recs = [json.loads(line) for line in f.read_text().splitlines()]
+    steps = [r for r in recs if r["event"] == "train_step"]
+    assert [r["step"] for r in steps] == [0, 1, 2, 3]
+    assert all(r["step_time_s"] >= r["data_wait_s"] >= 0 for r in steps)
+    compiles = [r for r in recs if r["event"] == "compile"]
+    assert len(compiles) == 1 and compiles[0]["step"] == 0
 
     # Resume: a fresh call with the same ckpt_dir restores step 4 and
     # trains on to step 6.

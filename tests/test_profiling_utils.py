@@ -89,6 +89,65 @@ def test_enable_persistent_cache_creates_0700(tmp_path, monkeypatch):
                           old_min)
 
 
+def test_step_profiler_anchors_window_on_resume(monkeypatch):
+    """A checkpoint-resumed run first observes step N != 0; the trace
+    window must anchor to that FIRST OBSERVED step (so the compile
+    steps are still skipped), not to absolute step numbers."""
+    import jax
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop", None)))
+    from raft_tpu.utils.profiling import StepProfiler
+
+    sp = StepProfiler(trace_dir="/tmp/x", start_step=2, num_steps=1)
+    traced = []
+    for step in range(1000, 1010):  # resumed at step 1000
+        sp.maybe_start(step)
+        if sp._running:
+            traced.append(step)
+        sp.maybe_stop(step, sync_on=None)
+    assert traced == [1002]  # 1000 + start_step, exactly num_steps long
+    assert [c[0] for c in calls] == ["start", "stop"]
+    assert sp._done
+    sp.close()
+
+    # disabled profiler: no anchoring, no trace calls
+    calls.clear()
+    off = StepProfiler(trace_dir=None)
+    off.maybe_start(0)
+    off.maybe_stop(0)
+    assert calls == [] and off._first_step is None
+
+
+def test_compile_counter_registry_mirror():
+    """With a registry attached, compile events also land on a labeled
+    telemetry counter (the serving engine's /metrics wiring)."""
+    from raft_tpu.obs import MetricRegistry
+
+    reg = MetricRegistry()
+    c = CompileCounter(
+        registry=reg, metric="raft_serve_compiles_total",
+        labeler=lambda key: {"bucket": f"{key[0][0]}x{key[0][1]}",
+                             "batch": str(key[1])})
+    c.record(((440, 1024), 8))
+    c.record(((440, 1024), 8))
+    c.record(((368, 496), 4))
+    m = reg.counter("raft_serve_compiles_total")
+    assert m.value(bucket="440x1024", batch="8") == 2
+    assert m.value(bucket="368x496", batch="4") == 1
+    # ledger unchanged
+    assert c.total() == 3
+
+    # default labeler: one key=str(key) label
+    reg2 = MetricRegistry()
+    c2 = CompileCounter(registry=reg2)
+    c2.record("step")
+    assert reg2.counter("raft_compiles_total").value(key="step") == 1
+
+
 def test_compile_counter():
     c = CompileCounter()
     key = ((440, 1024), 8)

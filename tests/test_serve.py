@@ -9,6 +9,7 @@ the CPU backend, so the whole file stays inside the fast tier."""
 
 import io
 import json
+import re
 import threading
 import urllib.error
 import urllib.request
@@ -18,6 +19,7 @@ import pytest
 
 from raft_tpu.config import RAFTConfig
 from raft_tpu.serve import InferenceEngine, QueueFullError, ServeConfig
+from raft_tpu.serve.stats import Counters, LatencyRecorder
 
 CFG = RAFTConfig.small_model()  # fp32 compute: bit-comparable to eval
 ITERS = 2
@@ -141,9 +143,42 @@ def test_http_round_trip(engine):
 
         with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
             assert r.read() == b"ok"
+        with urllib.request.urlopen(base + "/v1/healthz", timeout=30) as r:
+            assert r.read() == b"ok"
         with urllib.request.urlopen(base + "/v1/stats", timeout=30) as r:
             stats = json.loads(r.read())
         assert stats["completed"] >= 1 and "latency_ms" in stats
+        assert stats["latency_ms"]["count"] \
+            == stats["latency_ms"]["count_total"]
+
+        # /metrics: valid Prometheus text exposition, rendered from the
+        # SAME registry /v1/stats reads — request/latency counters agree.
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        metrics = {}
+        for line in text.splitlines():
+            assert line.startswith("#") or re.match(
+                r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}\n]*\})? -?[0-9.eE+-]+$",
+                line), f"unparseable exposition line: {line!r}"
+            if not line.startswith("#") and "{" not in line:
+                name, val = line.rsplit(" ", 1)
+                metrics[name] = float(val)
+        # stable metric names (the scrape-config contract)
+        for name in ("raft_serve_pairs_completed_total",
+                     "raft_serve_requests_rejected_total",
+                     "raft_serve_batches_total",
+                     "raft_serve_uptime_seconds",
+                     "raft_serve_pending_requests",
+                     "raft_serve_request_latency_seconds_count"):
+            assert name in metrics, (name, sorted(metrics))
+        stats2 = json.loads(urllib.request.urlopen(
+            base + "/v1/stats", timeout=30).read())
+        assert metrics["raft_serve_pairs_completed_total"] \
+            == stats2["completed"]
+        assert metrics["raft_serve_request_latency_seconds_count"] \
+            == stats2["latency_ms"]["count_total"]
 
         bad = urllib.request.Request(base + "/v1/flow", data=b"junk",
                                      method="POST")
@@ -176,6 +211,39 @@ def test_serve_cli_flag_parsing():
         from raft_tpu.cli.serve import main as serve_main
 
         serve_main(["--small"])
+
+
+def test_counters_failed_batch_keeps_lanes():
+    """A failed batch's real lanes stay in every lane denominator (as
+    ``failed_lanes``) — errors can no longer make ``occupancy`` and
+    ``mean_batch_fill`` read *healthier*."""
+    c = Counters()
+    c.mark_started()
+    c.add_batch(real=3, padded=1, failed=False)
+    snap_ok = c.snapshot(num_chips=1)
+    assert snap_ok["occupancy"] == 0.75
+    c.add_batch(real=2, padded=2, failed=True)
+    snap = c.snapshot(num_chips=1)
+    assert snap["completed"] == 3          # successes only
+    assert snap["failed_lanes"] == 2 and snap["errors"] == 1
+    # (3 + 2) real lanes over (3 + 2 + 1 + 2) total lanes
+    assert snap["occupancy"] == round(5 / 8, 3)
+    assert snap["mean_batch_fill"] == 2.5  # (3 + 2) real lanes / 2
+    # the old accounting (real lanes vanish) would have REPORTED better:
+    assert snap["occupancy"] < snap_ok["occupancy"]
+
+
+def test_latency_recorder_window_vs_lifetime():
+    lr = LatencyRecorder(window=4)
+    assert lr.snapshot() == {"count": 0, "count_total": 0,
+                             "window_count": 0, "p50_ms": 0.0,
+                             "p95_ms": 0.0, "p99_ms": 0.0, "mean_ms": 0.0}
+    for i in range(6):
+        lr.record(1.0 if i < 2 else 0.01)  # slow samples age out
+    s = lr.snapshot()
+    assert s["count_total"] == 6 and s["count"] == 6  # lifetime (alias)
+    assert s["window_count"] == 4                     # bounded window
+    assert s["p99_ms"] < 100                          # window-only stats
 
 
 def test_serve_config_validation():
