@@ -40,6 +40,13 @@ def _train_metric_name(h: int, w: int) -> str:
     return f"train_throughput_{_stage_name(h, w)}_{h}x{w}_bf16_iters12"
 
 
+def _input_metric_name(h: int, w: int) -> str:
+    """scripts/bench_input.py series — registered here next to the train
+    metric so input-pipeline records land on one stable per-stage name
+    (same sharing rule that keeps telemetry_summary.py from drifting)."""
+    return f"input_pipeline_{_stage_name(h, w)}_{h}x{w}"
+
+
 def bench_eval():
     """BENCH_MODE=eval: test-mode forward at the Sintel validation shape
     (436x1024 padded to 440x1024, 32 iters — reference evaluate.py:96),

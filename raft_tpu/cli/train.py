@@ -103,14 +103,38 @@ def parse_args(argv=None):
                         "into this directory (view with XProf/TB)")
     p.add_argument("--telemetry_dir", "--telemetry-dir", default=None,
                    help="write per-step JSONL telemetry (step_time_s, "
-                        "data_wait_s, pairs/sec/chip, compile + hbm "
-                        "events; docs/OBSERVABILITY.md) into this "
+                        "queue_wait_s, h2d_s, pairs/sec/chip, compile + "
+                        "hbm events; docs/OBSERVABILITY.md) into this "
                         "directory; defaults to $RAFT_TELEMETRY_DIR, "
                         "unset = disabled")
     p.add_argument("--num_workers", type=int, default=0,
                    help="loader prefetch threads; 0 = min(16, cpu_count) "
                         "(the native augmentation kernels release the "
                         "GIL, so threads scale on multi-core pod hosts)")
+    p.add_argument("--accum_steps", "--accum-steps", type=int, default=1,
+                   help="gradient-accumulation microbatches per step: "
+                        "the per-host batch is split into this many "
+                        "equal microbatches scanned with fp32 grad "
+                        "accumulation before the single optimizer "
+                        "update — keeps the paper's effective batch "
+                        "when HBM bounds the per-step batch "
+                        "(docs/PERFORMANCE.md); must divide the "
+                        "per-host batch; 1 = off")
+    p.add_argument("--prefetch_batches", "--prefetch-batches", type=int,
+                   default=0,
+                   help="loader decode window in BATCHES (decode "
+                        "futures in flight ahead of the consumer); "
+                        "0 = the legacy max(2*batch, 2*workers)-sample "
+                        "default")
+    p.add_argument("--device_prefetch", "--device-prefetch", type=int,
+                   default=2,
+                   help="device-prefetch buffer depth: batches host-"
+                        "prepped and device_put ahead of the consuming "
+                        "step on a background thread, so the H2D copy "
+                        "of batch N+1 overlaps the step on batch N; "
+                        "0 = the serial fetch->prep->put->step path "
+                        "(A/B; the batch stream is bit-identical "
+                        "either way)")
     p.add_argument("--shard_spatial", type=int, default=1, metavar="N",
                    help="shard activations (image height) over N mesh "
                         "devices in addition to data parallelism — for "
@@ -213,6 +237,19 @@ def main(argv=None):
                 f"--shard_spatial {args.shard_spatial} needs image height "
                 f"{args.image_size[0]} divisible by "
                 f"{8 * args.shard_spatial} (1/8-res rows split evenly)")
+    if args.accum_steps < 1:
+        raise SystemExit(f"--accum_steps must be >= 1, got "
+                         f"{args.accum_steps}")
+    if args.prefetch_batches < 0 or args.device_prefetch < 0:
+        raise SystemExit("--prefetch_batches / --device_prefetch must "
+                         "be >= 0")
+    per_host_batch = batch_size // num_hosts
+    if per_host_batch % args.accum_steps:
+        raise SystemExit(
+            f"--accum_steps {args.accum_steps} must divide the per-host "
+            f"batch size {per_host_batch} (global {batch_size} over "
+            f"{num_hosts} host(s)) evenly — pick a batch size that is a "
+            f"multiple of accum_steps * num_hosts")
     cfg = TrainConfig(
         name=args.name, stage=args.stage, restore_ckpt=args.restore_ckpt,
         validation=tuple(args.validation), lr=lr,
@@ -222,6 +259,9 @@ def main(argv=None):
         gamma=args.gamma, add_noise=args.add_noise, seed=args.seed,
         val_freq=args.val_freq,
         freeze_bn=args.stage != "chairs",  # reference train.py:147-148
+        accum_steps=args.accum_steps,
+        prefetch_batches=args.prefetch_batches,
+        device_prefetch=args.device_prefetch,
         ckpt_dir=args.ckpt_dir)
     dataset = fetch_dataset(args.stage, tuple(args.image_size),
                             root=args.data_root,
@@ -237,7 +277,8 @@ def main(argv=None):
     loader = ShardedLoader(dataset, batch_size // num_hosts,
                            seed=args.seed, num_hosts=num_hosts,
                            host_id=jax.process_index(),
-                           num_workers=num_workers)
+                           num_workers=num_workers,
+                           prefetch_batches=args.prefetch_batches)
 
     restore = None
     if args.restore_ckpt:

@@ -295,6 +295,27 @@ class TrainConfig:
     # compute the fused path upsamples bf16 while the stacked path
     # stays fp32, a bf16-rounding-level difference).
     fused_loss: bool = True
+    # Gradient-accumulation microbatching: split the per-host batch into
+    # ``accum_steps`` equal microbatches and run a lax.scan over them with
+    # fp32 gradient accumulation before the single optax update.  The
+    # parameter update equals the full-batch step at equal effective
+    # batch (the sequence loss is a mean over batch elements), while peak
+    # activation memory scales with ``batch/accum_steps`` — the path that
+    # keeps the paper's effective batch 10 when HBM bounds the per-step
+    # batch (FlyingThings 720p crops with spatial sharding off).  The
+    # per-host batch must divide evenly; dropout draws a distinct RNG per
+    # microbatch (identical at the default dropout=0).  1 = off.
+    accum_steps: int = 1
+    # Host-loader decode window in BATCHES (``ShardedLoader`` keeps this
+    # many batches of decode futures in flight); 0 = the loader's legacy
+    # default of max(2*batch, 2*workers) samples.
+    prefetch_batches: int = 0
+    # Device-prefetch buffer depth: batches decoded + host-prepped +
+    # device_put'd ahead of the consuming step on a background producer
+    # thread (raft_tpu/data/prefetch.py), so the H2D transfer of batch
+    # N+1 overlaps the device step on batch N.  0 = the fully serial
+    # fetch->prep->put->step path (for A/B); 2 = double buffering.
+    device_prefetch: int = 2
     ckpt_dir: str = "checkpoints"
     # Number of data-parallel shards (devices); resolved at runtime.
     num_devices: int = 0

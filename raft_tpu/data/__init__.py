@@ -26,4 +26,7 @@ def __getattr__(name):
     if name in {"FlowAugmentor", "SparseFlowAugmentor", "ColorJitter"}:
         from raft_tpu.data import augment as _a
         return getattr(_a, name)
+    if name == "DevicePipeline":
+        from raft_tpu.data.prefetch import DevicePipeline
+        return DevicePipeline
     raise AttributeError(name)

@@ -348,10 +348,18 @@ class ShardedLoader:
     host_id: int = 0
     num_workers: int = 4
     drop_last: bool = True
+    # Decode-window depth in BATCHES: how many batches of decode futures
+    # the thread pool keeps in flight ahead of the consumer.  0 = the
+    # legacy default of max(2*batch_size, 2*num_workers) SAMPLES.
+    # Raise it when per-sample decode latency is spiky (network
+    # filesystems) so a slow sample doesn't drain the window; it bounds
+    # decoded-sample host RAM at ~prefetch_batches*batch_size samples.
+    prefetch_batches: int = 0
 
     def __post_init__(self):
         assert 0 <= self.host_id < self.num_hosts
         assert len(self.dataset) > 0, "empty dataset"
+        assert self.prefetch_batches >= 0, self.prefetch_batches
 
     def epoch_indices(self, epoch: int) -> np.ndarray:
         """The host's sample indices for ``epoch`` — a disjoint stride of a
@@ -394,10 +402,14 @@ class ShardedLoader:
         from collections import deque
 
         epoch = start_epoch
-        # Bounded prefetch: at most ~2 batches of futures in flight, so the
-        # workers can't race ahead of the consumer and buffer an entire
-        # epoch of decoded samples in host RAM.
-        window = max(2 * self.batch_size, 2 * self.num_workers)
+        # Bounded prefetch: a fixed window of decode futures in flight,
+        # so the workers can't race ahead of the consumer and buffer an
+        # entire epoch of decoded samples in host RAM.  The depth is the
+        # ``prefetch_batches`` knob (in batches); 0 keeps the legacy
+        # ~2-batch default.
+        window = (self.prefetch_batches * self.batch_size
+                  if self.prefetch_batches > 0
+                  else max(2 * self.batch_size, 2 * self.num_workers))
         with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
             while True:
                 idx = self.epoch_indices(epoch)

@@ -1,0 +1,194 @@
+"""Background device-prefetch: the overlapped training input pipeline.
+
+The serial loop (PRs 0-2) ran fetch -> noise -> ``shard_batch``
+(``jax.device_put``) -> step entirely on the consumer thread, so host
+decode, host prep, and the H2D enqueue all sat in the step's critical
+path — exactly the gap PR 2's ``data_wait_s`` input-bound detector made
+visible.  :class:`DevicePipeline` moves stages (2) host prep (noise
+injection / stacking) and (3) device placement onto ONE background
+producer thread feeding a bounded buffer, so while the device runs step
+N the producer is already prepping and transferring batches
+N+1..N+depth.  ``device_put`` dispatch is async, so "transfer" costs the
+producer only the enqueue; the copy itself overlaps device compute.
+RAFT's 12-32 refinement iterations make each step long enough to hide
+all of it (PAPER.md; docs/PERFORMANCE.md has the overlap model).
+
+Ordering/determinism contract: exactly ONE producer pulls the host
+iterator and applies ``prep_fn`` in stream order — the same order the
+serial path uses — so a *stateful* prep (the noise RNG keyed on the
+resume step, ``raft_tpu/train/loop.py``) sees an identical call
+sequence whether the pipeline is buffered (``depth > 0``) or serial
+(``depth == 0``), and resume via ``ShardedLoader.batches_from_step``
+stays bit-equivalent.  ``depth == 0`` is not a degraded mode but the
+exact old serial path (prep + put inline in ``__next__``), kept for
+A/B against the overlapped one.
+
+Boundedness: a semaphore of ``depth`` slots is acquired BEFORE the
+producer touches the source iterator and released when the consumer
+takes a batch, so at most ``depth`` batches are ever held by the
+pipeline (pulled-but-undelivered) beyond the one the consumer is
+stepping on — the device buffers of a deep queue would otherwise
+accumulate in HBM.
+
+Telemetry: the producer times both stages per batch and the consumer
+reads them (``last_prep_s`` / ``last_h2d_s``) right after ``next()``,
+so the train loop can split the old ``data_wait_s`` into consumer-side
+queue wait (the true input-bound signal under overlap) and the
+producer-side ``h2d_s`` span (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Iterator, Optional
+
+# Producer -> consumer message kinds.
+_ITEM, _END, _ERROR = "item", "end", "error"
+
+
+class DevicePipeline:
+    """Iterator of device-resident batches with bounded background
+    prefetch.
+
+    ``batches``: the host batch iterator (e.g. ``ShardedLoader.batches``
+    or ``batches_from_step``).
+    ``put_fn``: host batch -> device-resident batch (e.g.
+    :func:`raft_tpu.parallel.make_batch_sharder`); None = identity
+    (host-only pipelining, used by tests and the input microbench).
+    ``prep_fn``: host-side prep applied before ``put_fn`` (noise
+    injection); called in stream order by exactly one thread.
+    ``depth``: buffered batches beyond the one handed to the consumer;
+    0 = synchronous serial path (no thread).
+
+    Iteration: ``next(pipeline)`` returns the next device-resident
+    batch; ``StopIteration`` when the source ends.  A producer-side
+    exception re-raises in the consumer's ``next()``.  ``close()``
+    (also via context manager) stops the producer and drops buffered
+    batches so their device memory frees promptly; it is called by the
+    train loop's ``finally``.
+    """
+
+    def __init__(self, batches: Iterable, *,
+                 put_fn: Optional[Callable] = None,
+                 prep_fn: Optional[Callable] = None,
+                 depth: int = 2):
+        if depth < 0:
+            raise ValueError(f"device-prefetch depth must be >= 0, "
+                             f"got {depth}")
+        self._src: Iterator = iter(batches)
+        self._put = put_fn if put_fn is not None else (lambda b: b)
+        self._prep = prep_fn
+        self.depth = int(depth)
+        # Per-batch producer spans, valid right after next() returns.
+        self.last_prep_s = 0.0
+        self.last_h2d_s = 0.0
+        # Cumulative, for the input microbench / pipeline stats.
+        self.prep_total_s = 0.0
+        self.h2d_total_s = 0.0
+        self.batches_out = 0
+        self._closed = False
+        if self.depth > 0:
+            # The queue itself is unbounded; _slots enforces the
+            # in-flight bound (acquired BEFORE the source is pulled).
+            self._q: queue.Queue = queue.Queue()
+            self._slots = threading.Semaphore(self.depth)
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._produce, name="raft-device-prefetch",
+                daemon=True)
+            self._thread.start()
+
+    # -- producer (depth > 0) -------------------------------------------
+    def _produce(self) -> None:
+        try:
+            while True:
+                # Slot first: never pull (or decode, or device_put) a
+                # batch there is no buffer budget for.
+                while not self._slots.acquire(timeout=0.05):
+                    if self._stop.is_set():
+                        return
+                if self._stop.is_set():
+                    return
+                try:
+                    batch = next(self._src)
+                except StopIteration:
+                    self._q.put((_END, None, 0.0, 0.0))
+                    return
+                t0 = time.perf_counter()
+                if self._prep is not None:
+                    batch = self._prep(batch)
+                t1 = time.perf_counter()
+                batch = self._put(batch)
+                t2 = time.perf_counter()
+                self._q.put((_ITEM, batch, t1 - t0, t2 - t1))
+        except BaseException as e:  # re-raised in the consumer
+            self._q.put((_ERROR, e, 0.0, 0.0))
+
+    # -- consumer --------------------------------------------------------
+    def __iter__(self) -> "DevicePipeline":
+        return self
+
+    def _account(self, prep_s: float, h2d_s: float) -> None:
+        self.last_prep_s = prep_s
+        self.last_h2d_s = h2d_s
+        self.prep_total_s += prep_s
+        self.h2d_total_s += h2d_s
+        self.batches_out += 1
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        if self.depth == 0:
+            # The exact old serial path: prep + put inline, on this
+            # thread, one batch at a time.
+            batch = next(self._src)  # StopIteration propagates
+            t0 = time.perf_counter()
+            if self._prep is not None:
+                batch = self._prep(batch)
+            t1 = time.perf_counter()
+            batch = self._put(batch)
+            t2 = time.perf_counter()
+            self._account(t1 - t0, t2 - t1)
+            return batch
+        kind, payload, prep_s, h2d_s = self._q.get()
+        if kind == _END:
+            self._closed = True
+            raise StopIteration
+        if kind == _ERROR:
+            self._closed = True
+            raise payload
+        self._slots.release()
+        self._account(prep_s, h2d_s)
+        return payload
+
+    def buffered(self) -> int:
+        """Batches currently sitting in the buffer (0 on the serial
+        path); bounded by ``depth``."""
+        return 0 if self.depth == 0 else self._q.qsize()
+
+    def close(self) -> None:
+        """Stop the producer and drop buffered batches.  Idempotent.
+
+        The producer may be blocked inside ``next(source)`` (host IO);
+        like the serial loop, that cannot be interrupted — the stop flag
+        is observed at the next slot/batch boundary, and the thread is
+        daemonic so a wedged loader cannot hang interpreter exit."""
+        self._closed = True
+        if self.depth == 0:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        try:
+            while True:  # free buffered device arrays promptly
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __enter__(self) -> "DevicePipeline":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -4,7 +4,7 @@ One record per event, one line per record, append-only::
 
     {"event": "train_step", "t_wall": 1722777600.123,
      "t_mono": 123.456, "process": 0, "step": 42,
-     "step_time_s": 0.51, "data_wait_s": 0.002, ...}
+     "step_time_s": 0.51, "queue_wait_s": 0.002, ...}
 
 - ``t_wall`` is ``time.time()`` (correlate across hosts / with XProf
   traces); ``t_mono`` is ``time.perf_counter()`` (durations within one

@@ -8,13 +8,21 @@ cadence is unchanged with telemetry on).
 
 Per step it records/emits:
 
-- ``step_time_s``: wall time of the whole loop iteration (fetch +
-  host-side prep + dispatch).  Dispatch is async, so once the pipeline
-  fills, host iteration time converges to device step time.
-- ``data_wait_s``: time blocked in ``next()`` on the input iterator —
-  the input-bound detector.  ``data_wait_s/step_time_s`` near 1 on a
-  v5e means the chips are starving and the loader needs workers, not
-  the model an optimizer.
+- ``step_time_s``: wall time of the whole loop iteration (queue wait +
+  dispatch).  Dispatch is async, so once the pipeline fills, host
+  iteration time converges to device step time.
+- ``queue_wait_s``: time blocked in ``next()`` on the input pipeline —
+  the input-bound detector.  This is the consumer side of what PR 2
+  called ``data_wait_s``: with device prefetch on it is pure queue
+  wait (near 0 when the producer keeps up); at ``device_prefetch=0``
+  it is the full serial fetch+prep+H2D cost.  ``queue_wait/step_time``
+  near 1 on a v5e means the chips are starving and the loader needs
+  workers/depth, not the model an optimizer.
+- ``h2d_s`` / ``prep_s``: the producer-side spans for the batch the
+  step consumed — ``device_put`` dispatch and host prep (noise).
+  These run OFF the critical path when prefetch is on; a large
+  ``h2d_s`` with a small ``queue_wait_s`` means the overlap is doing
+  its job (docs/PERFORMANCE.md has the triage table).
 - ``pairs_per_sec_per_chip``: ``batch / step_time / num_devices`` — the
   BASELINE.json north-star metric as a continuously measured number.
 
@@ -60,8 +68,15 @@ class TrainTelemetry:
         self._step_hist = self.registry.histogram(
             "raft_train_step_seconds", "wall time per training step")
         self._wait_hist = self.registry.histogram(
-            "raft_train_data_wait_seconds",
-            "time blocked on the input iterator per step")
+            "raft_train_queue_wait_seconds",
+            "consumer time blocked on the input pipeline per step "
+            "(the input-bound signal; serial fetch cost at depth 0)")
+        self._h2d_hist = self.registry.histogram(
+            "raft_train_h2d_seconds",
+            "producer-side device_put dispatch span per batch")
+        self._prep_hist = self.registry.histogram(
+            "raft_train_host_prep_seconds",
+            "producer-side host prep (noise) span per batch")
         self._pps = self.registry.gauge(
             "raft_train_pairs_per_sec_per_chip",
             "batch / step_time / num_devices, last step")
@@ -76,17 +91,22 @@ class TrainTelemetry:
                        num_steps=int(num_steps))
 
     def record_step(self, step: int, step_time_s: float,
-                    data_wait_s: float) -> None:
+                    queue_wait_s: float, h2d_s: float = 0.0,
+                    prep_s: float = 0.0) -> None:
         if not self.enabled:
             return
         pps = (self.batch_size / step_time_s / self.num_devices
                if step_time_s > 0 else 0.0)
         self._step_hist.observe(step_time_s)
-        self._wait_hist.observe(data_wait_s)
+        self._wait_hist.observe(queue_wait_s)
+        self._h2d_hist.observe(h2d_s)
+        self._prep_hist.observe(prep_s)
         self._pps.set(pps)
         self.sink.emit("train_step", step=step,
                        step_time_s=round(step_time_s, 6),
-                       data_wait_s=round(data_wait_s, 6),
+                       queue_wait_s=round(queue_wait_s, 6),
+                       h2d_s=round(h2d_s, 6),
+                       prep_s=round(prep_s, 6),
                        pairs_per_sec_per_chip=round(pps, 3))
 
     def record_compile(self, step: int, seconds: float, key) -> None:

@@ -3,6 +3,7 @@
 from raft_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     batch_sharding,
+    make_batch_sharder,
     replicated_sharding,
     shard_batch,
     spatial_batch_sharding,

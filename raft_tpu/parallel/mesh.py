@@ -60,18 +60,37 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def make_batch_sharder(mesh: Mesh, spatial: bool = False):
+    """Build ``put(batch) -> sharded batch``: the host->device placement
+    closure with the sharding and the single/multi-host branch resolved
+    ONCE (the device-prefetch producer calls it once per batch from a
+    background thread; ``raft_tpu/data/prefetch.py``).
+
+    Single-host: a plain sharded ``device_put`` — dispatch is async, so
+    the call returns as soon as the transfer is enqueued and the H2D copy
+    itself overlaps whatever the device is running.  Multi-host: each
+    process passes its *local* batch (its stride of the global shuffle
+    from ``ShardedLoader``) and the global array is assembled from the
+    process-local shards — the global batch is ``num_hosts * local_batch``.
+    """
+    sh = spatial_batch_sharding(mesh) if spatial else batch_sharding(mesh)
+    if jax.process_count() == 1:
+        def put(batch):
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sh), batch)
+    else:
+        def put(batch):
+            return jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(sh, x),
+                batch)
+    return put
+
+
 def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh,
                 spatial: bool = False):
     """Place a host batch onto the mesh, batch-dim sharded over ``data``
     (and, with ``spatial=True``, image height over ``spatial``).
 
-    Single-host: a plain sharded device_put.  Multi-host: each process
-    passes its *local* batch (its stride of the global shuffle from
-    ``ShardedLoader``) and the global array is assembled from the
-    process-local shards — the global batch is ``num_hosts * local_batch``.
-    """
-    sh = spatial_batch_sharding(mesh) if spatial else batch_sharding(mesh)
-    if jax.process_count() == 1:
-        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
-    return jax.tree_util.tree_map(
-        lambda x: jax.make_array_from_process_local_data(sh, x), batch)
+    One-shot form of :func:`make_batch_sharder` (see there for the
+    single/multi-host semantics)."""
+    return make_batch_sharder(mesh, spatial=spatial)(batch)

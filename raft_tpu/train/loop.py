@@ -3,15 +3,25 @@
 TPU-first shape of the loop:
 
 - one jitted SPMD step over the device mesh (no DataParallel wrapper);
-- host-side loader threads overlap decode/augment with device compute
-  (dispatch is async; the only sync point is the periodic metrics pull);
+- a three-stage overlapped input pipeline (``DevicePipeline``,
+  docs/PERFORMANCE.md): loader threads decode/augment, a background
+  producer runs host prep (noise) + async ``device_put``, and the loop
+  consumes already-device-resident batches — H2D transfer of batch N+1
+  overlaps the device step on batch N (``cfg.device_prefetch``; 0 = the
+  old serial fetch->prep->put->step path, bit-identical batches either
+  way);
+- ``cfg.accum_steps`` splits the per-host batch into microbatches with
+  fp32 gradient accumulation (train/step.py) for HBM-bound configs;
 - orbax checkpoints carry the full state; a preempted run auto-resumes
   from the latest step (the reference restarts its schedule, SURVEY.md §5);
-- optional gaussian image noise parity (train.py:167-170).
+- optional gaussian image noise parity (train.py:167-170), applied in
+  the pipeline's producer in stream order so the per-step noise is
+  identical with prefetch on or off.
 """
 
 from __future__ import annotations
 
+import functools
 import os
 import time
 from typing import Callable, Dict, Optional
@@ -20,9 +30,10 @@ import jax
 import numpy as np
 
 from raft_tpu.config import RAFTConfig, TrainConfig
+from raft_tpu.data.prefetch import DevicePipeline
 from raft_tpu.models.raft import RAFT
 from raft_tpu.obs.train import TrainTelemetry
-from raft_tpu.parallel import make_mesh, shard_batch
+from raft_tpu.parallel import make_batch_sharder, make_mesh
 from raft_tpu.train.checkpoint import CheckpointManager
 from raft_tpu.train.logger import Logger
 from raft_tpu.train.loss import sequence_loss  # noqa: F401 (re-export)
@@ -111,10 +122,17 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
     activation/corr-volume sharding path for inputs too large for one
     chip's HBM.
     ``telemetry_dir``: write per-step JSONL telemetry (``step_time_s``,
-    ``data_wait_s``, ``pairs_per_sec_per_chip``, compile + hbm events —
-    docs/OBSERVABILITY.md) here; defaults to ``$RAFT_TELEMETRY_DIR``,
-    unset = disabled.  All telemetry timing is host-side
-    ``perf_counter`` — it adds NO device sync to the step path.
+    ``queue_wait_s``, ``h2d_s``, ``pairs_per_sec_per_chip``, compile +
+    hbm events — docs/OBSERVABILITY.md) here; defaults to
+    ``$RAFT_TELEMETRY_DIR``, unset = disabled.  All telemetry timing is
+    host-side ``perf_counter`` — it adds NO device sync to the step path.
+
+    Input overlap: ``cfg.device_prefetch`` batches are host-prepped and
+    ``device_put`` ahead of the consuming step on a background producer
+    (``raft_tpu/data/prefetch.py``); 0 restores the serial path.  The
+    batch stream — order, content, and noise per global step, including
+    mid-epoch resume via ``batches_from_step`` — is bit-identical either
+    way.  ``cfg.accum_steps`` microbatches the step (train/step.py).
     """
     assert (batches is None) != (loader is None), \
         "pass exactly one of batches= or loader="
@@ -148,10 +166,23 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
     step = int(state.step)
     if loader is not None:
         batches = loader.batches_from_step(step)
-    # Noise RNG keyed on the resume step so a resumed run doesn't replay
-    # the same noise sequence from the beginning.
-    noise_rng = np.random.default_rng(
-        np.random.SeedSequence([cfg.seed + 1, step]))
+    prep_fn = None
+    if cfg.add_noise:
+        # Noise RNG keyed on the resume step so a resumed run doesn't
+        # replay the same noise sequence from the beginning.  Applied by
+        # the pipeline's single producer in stream order, so step k's
+        # noise is identical whether device_prefetch is 0 or N (the
+        # producer is the only consumer of this generator).
+        noise_rng = np.random.default_rng(
+            np.random.SeedSequence([cfg.seed + 1, step]))
+        prep_fn = functools.partial(add_image_noise, noise_rng)
+    # The overlapped input pipeline: decode (loader threads) -> host prep
+    # (noise) -> async device_put, double/triple-buffered ahead of the
+    # consuming step.  depth 0 = the old serial path, same batch stream.
+    pipeline = DevicePipeline(
+        batches, put_fn=make_batch_sharder(mesh, spatial=shard_spatial),
+        prep_fn=prep_fn,
+        depth=max(int(getattr(cfg, "device_prefetch", 0)), 0))
     profiler = StepProfiler(profile_dir)
     telem = TrainTelemetry(telemetry_dir, batch_size=cfg.batch_size,
                            num_devices=max(jax.device_count(), 1),
@@ -159,35 +190,34 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
     telem.start(start_step=step, num_steps=cfg.num_steps)
     t0, steps_t0 = time.time(), step
     first_dispatched = False
-    batch_iter = iter(batches)
     try:
         while True:
-            # data_wait_s: time blocked on the input iterator — the
+            # queue_wait_s: time blocked on the input pipeline — the
             # input-bound detector (host perf_counter only; the step
-            # loop stays async).
+            # loop stays async).  With device prefetch on this is pure
+            # consumer-side queue wait (near 0 when the producer keeps
+            # up); at depth 0 it degrades to the full serial
+            # fetch+prep+H2D cost — the old data_wait_s.
             t_iter = time.perf_counter()
             try:
-                batch = next(batch_iter)
+                sharded = next(pipeline)
             except StopIteration:
                 break
-            data_wait_s = time.perf_counter() - t_iter
+            queue_wait_s = time.perf_counter() - t_iter
             if step >= cfg.num_steps:
                 break
             if (jax.process_count() == 1 and _PREEMPT.is_set()) or (
                     jax.process_count() > 1
                     and _reached_preemption_sync(step)):
                 raise SystemExit(143)  # step boundary; state is consistent
-            if cfg.add_noise:
-                batch = add_image_noise(noise_rng, batch)
             profiler.maybe_start(step)
-            sharded = shard_batch(batch, mesh, spatial=shard_spatial)
             with annotate_step(step):
                 state, metrics = step_fn(state, sharded, key)
             profiler.maybe_stop(step, sync_on=metrics.get("loss"))
             step += 1
             logger.push(step - 1, metrics)
-            # step_time_s covers fetch + host prep + dispatch.  Dispatch
-            # is async, so once the pipeline fills this converges to the
+            # step_time_s covers queue wait + dispatch.  Dispatch is
+            # async, so once the pipeline fills this converges to the
             # device step time without ever forcing a transfer.
             step_time_s = time.perf_counter() - t_iter
             if not first_dispatched:
@@ -205,7 +235,9 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                     # skips it).  Purely host-side, runs once.
                     telem.record_hbm(hbm_usage(step_fn, state, sharded,
                                                key))
-            telem.record_step(step - 1, step_time_s, data_wait_s)
+            telem.record_step(step - 1, step_time_s, queue_wait_s,
+                              h2d_s=pipeline.last_h2d_s,
+                              prep_s=pipeline.last_prep_s)
 
             # Second preemption check before the (potentially minutes-
             # long) save+validate block, so a SIGTERM during the step
@@ -214,9 +246,10 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             # early exit here on one host would strand the others in the
             # collective save/validate block — multi-host preemption
             # exits solely through the agreed-step sync at the top of
-            # the loop.  Caveat: a SIGTERM while the data loader itself
-            # is blocked in ``next(batches)`` is only observed once the
-            # loader yields — the flag cannot interrupt host-side IO.
+            # the loop.  Caveat: a SIGTERM while the consumer is blocked
+            # on the input pipeline (``next(pipeline)``) is only observed
+            # once a batch arrives — the flag cannot interrupt host-side
+            # IO (the prefetch producer has the same boundary).
             if jax.process_count() == 1 and _PREEMPT.is_set():
                 raise SystemExit(143)
 
@@ -255,6 +288,7 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             mgr.save(int(state.step), state, force=True)
         raise
     finally:
+        pipeline.close()
         mgr.wait()
         mgr.close()
         profiler.close()

@@ -54,6 +54,22 @@ def make_train_step(model: RAFT, tx: optax.GradientTransformation,
     ``spatial`` axis (activation/corr-volume sharding for large inputs —
     GSPMD inserts the halo exchanges and gathers).
     ``freeze_bn`` is static per-stage (reference train.py:147-148).
+
+    ``cfg.accum_steps > 1`` enables gradient-accumulation microbatching:
+    the batch is reshaped to ``(accum, B/accum, ...)`` and a ``lax.scan``
+    runs forward+backward per microbatch, accumulating gradients in fp32;
+    the single optax update then sees the mean gradient — equal to the
+    full-batch gradient at equal effective batch (the sequence loss is a
+    mean over batch elements), within fp32 reduction-order tolerance.
+    Peak activation/temp memory scales with the microbatch, which is what
+    keeps the paper's effective batch 10 on HBM-bound configs.  Notes:
+    dropout draws a distinct RNG per microbatch (identical at the default
+    dropout=0); BatchNorm running stats chain through the scan (each
+    microbatch updates them in sequence — the same as training with
+    smaller batches, not bit-identical to one full-batch update, and the
+    batch-stat *normalization* couples only within a microbatch, so use
+    ``freeze_bn`` stages — every stage but chairs — for exact-parity
+    needs); logged metrics are the mean of per-microbatch metrics.
     """
 
     def loss_fn(params, batch_stats, batch, rng):
@@ -86,11 +102,52 @@ def make_train_step(model: RAFT, tx: optax.GradientTransformation,
                 gamma=cfg.gamma, max_flow=cfg.max_flow)
         return loss, (metrics, new_vars.get("batch_stats"))
 
+    accum = max(int(getattr(cfg, "accum_steps", 1)), 1)
+
     def step_fn(state: TrainState, batch: Dict, rng: jax.Array):
         rng = jax.random.fold_in(rng, state.step)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, (metrics, new_bs)), grads = grad_fn(
-            state.params, state.batch_stats, batch, rng)
+        if accum == 1:
+            (loss, (metrics, new_bs)), grads = grad_fn(
+                state.params, state.batch_stats, batch, rng)
+        else:
+            B = batch["image1"].shape[0]
+            if B % accum:
+                raise ValueError(
+                    f"accum_steps={accum} must divide the batch size "
+                    f"{B} evenly (remainder {B % accum}); pick a batch "
+                    f"size that is a multiple of accum_steps")
+            micro = jax.tree_util.tree_map(
+                lambda x: x.reshape((accum, B // accum) + x.shape[1:]),
+                batch)
+
+            def body(carry, xs):
+                acc, bs = carry
+                mb, i = xs
+                (loss_i, (metrics_i, new_bs)), grads_i = grad_fn(
+                    state.params, bs, mb, jax.random.fold_in(rng, i))
+                # fp32 accumulation regardless of the grad dtype, so
+                # summing `accum` near-equal terms doesn't lose low bits
+                # before the mean.
+                acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads_i)
+                # None at trace time when batch_stats is absent/frozen —
+                # the carry then just threads the input stats through.
+                bs = bs if new_bs is None else new_bs
+                return (acc, bs), (loss_i, metrics_i)
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (acc, new_bs), (losses, metrics_seq) = jax.lax.scan(
+                body, (zeros, state.batch_stats),
+                (micro, jnp.arange(accum)))
+            # Mean of per-microbatch gradients == full-batch gradient
+            # (the loss is a mean over batch elements, equal sizes).
+            grads = jax.tree_util.tree_map(
+                lambda a, p: (a / accum).astype(p.dtype), acc,
+                state.params)
+            loss = jnp.mean(losses)
+            metrics = jax.tree_util.tree_map(jnp.mean, metrics_seq)
         new_state = state.apply_gradients(grads, tx, new_batch_stats=new_bs)
         metrics = dict(metrics, loss=loss,
                        grad_norm=optax.global_norm(grads))

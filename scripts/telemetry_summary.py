@@ -78,6 +78,15 @@ def last_run(records):
     return run_cfg, steps
 
 
+def _wait_s(rec):
+    """Consumer-side input wait of one train_step record.
+
+    PR 3 split ``data_wait_s`` into consumer-side ``queue_wait_s`` plus
+    the producer-side ``h2d_s``/``prep_s`` spans; older logs carry only
+    ``data_wait_s`` (which measured the same consumer-side block)."""
+    return rec.get("queue_wait_s", rec.get("data_wait_s", 0.0))
+
+
 def summarize(run_cfg, steps, skip=2):
     if run_cfg is None:
         raise SystemExit("no run_config event in log (telemetry written "
@@ -91,7 +100,8 @@ def summarize(run_cfg, steps, skip=2):
     n_dev = max(run_cfg.get("num_devices", 1), 1)
     h, w = run_cfg["image_size"]
     wall = sum(r["step_time_s"] for r in kept)
-    wait = sum(r["data_wait_s"] for r in kept)
+    wait = sum(_wait_s(r) for r in kept)
+    h2d = sum(r.get("h2d_s", 0.0) for r in kept)
     value = len(kept) * batch / wall / n_dev if wall > 0 else 0.0
     vs = (value / BASELINE_PAIRS_PER_SEC_PER_CHIP
           if _stage_name(h, w) == "flyingchairs" else 0.0)
@@ -108,7 +118,12 @@ def summarize(run_cfg, steps, skip=2):
             "image_size": [h, w],
             "steps_measured": len(kept),
             "steps_skipped": len(steps) - len(kept),
-            "data_wait_frac": round(wait / wall, 4) if wall > 0 else 0.0,
+            # queue_wait_frac near 1 -> input-bound (consumer starving);
+            # h2d_frac is producer-side and OVERLAPPED when device
+            # prefetch is on — big h2d_frac + small queue_wait_frac
+            # means the overlap is hiding the transfer, not a problem.
+            "queue_wait_frac": round(wait / wall, 4) if wall > 0 else 0.0,
+            "h2d_frac": round(h2d / wall, 4) if wall > 0 else 0.0,
             "step_time_p50_s": round(times[len(times) // 2], 6),
         },
     }

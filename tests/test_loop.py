@@ -64,7 +64,8 @@ def test_train_loop_checkpoint_and_resume(tmp_path, monkeypatch):
     recs = [json.loads(line) for line in f.read_text().splitlines()]
     steps = [r for r in recs if r["event"] == "train_step"]
     assert [r["step"] for r in steps] == [0, 1, 2, 3]
-    assert all(r["step_time_s"] >= r["data_wait_s"] >= 0 for r in steps)
+    assert all(r["step_time_s"] >= r["queue_wait_s"] >= 0 for r in steps)
+    assert all(r["h2d_s"] >= 0 for r in steps)
     compiles = [r for r in recs if r["event"] == "compile"]
     assert len(compiles) == 1 and compiles[0]["step"] == 0
 
@@ -105,9 +106,14 @@ def test_single_host_request_preemption_saves_and_resumes(tmp_path):
     from raft_tpu.train import loop as loop_mod
 
     mcfg = RAFTConfig.small_model(corr_levels=2, corr_radius=2)
+    # Serial pipeline: with background prefetch the producer races ahead
+    # of the consumer, so WHICH boundary observes the flag depends on
+    # thread timing — the exact-step assertion below needs depth 0 (the
+    # cooperative-save semantics are the same either way).
     tcfg = TrainConfig(name="p", lr=1e-4, num_steps=6, batch_size=8,
                        image_size=(32, 32), iters=2, val_freq=4,
-                       log_freq=2, ckpt_dir=str(tmp_path))
+                       log_freq=2, ckpt_dir=str(tmp_path),
+                       device_prefetch=0)
 
     def preempting_batches():
         for n, b in enumerate(_batches(10, tcfg)):

@@ -203,7 +203,8 @@ def test_train_telemetry_stream(tmp_path, monkeypatch):
     assert t.enabled
     t.start(start_step=0, num_steps=100)
     t.record_compile(0, 12.5, key=("train_step", (368, 496), 16))
-    t.record_step(0, step_time_s=0.5, data_wait_s=0.01)
+    t.record_step(0, step_time_s=0.5, queue_wait_s=0.01, h2d_s=0.002,
+                  prep_s=0.001)
     t.record_hbm({"peak_hbm_gb": 3.5})
     t.close()
     (f,) = tmp_path.glob("*.jsonl")
@@ -214,7 +215,8 @@ def test_train_telemetry_stream(tmp_path, monkeypatch):
     rc = by_event["run_config"]
     assert rc["batch_size"] == 16 and rc["image_size"] == [368, 496]
     ts = by_event["train_step"]
-    assert ts["step_time_s"] == 0.5 and ts["data_wait_s"] == 0.01
+    assert ts["step_time_s"] == 0.5 and ts["queue_wait_s"] == 0.01
+    assert ts["h2d_s"] == 0.002 and ts["prep_s"] == 0.001
     assert ts["pairs_per_sec_per_chip"] == 8.0  # 16 / 0.5 / 4
     assert by_event["hbm_usage"]["peak_hbm_gb"] == 3.5
     summary = by_event["metrics_summary"]["metrics"]
@@ -291,11 +293,14 @@ def _slow_batches(n, batch_size, hw, slow_steps=(), delay=0.06):
 def test_loop_data_wait_and_no_per_step_sync(tmp_path, monkeypatch,
                                              capsys):
     """The acceptance contract in one run: the telemetry JSONL carries
-    per-step ``step_time_s``/``data_wait_s``; an artificially slow
-    iterator shows up in ``data_wait_s``; the ONLY host transfers are
-    the Logger's once-per-interval flushes (telemetry adds zero, and
-    the flush cadence is unchanged); and scripts/telemetry_summary.py
-    folds the log into bench.py JSON."""
+    per-step ``step_time_s``/``queue_wait_s``/``h2d_s``; an
+    artificially slow iterator shows up in ``queue_wait_s``; the ONLY
+    host transfers are the Logger's once-per-interval flushes
+    (telemetry adds zero, and the flush cadence is unchanged); and
+    scripts/telemetry_summary.py folds the log into bench.py JSON.
+    Serial pipeline (device_prefetch=0) so the slow fetch lands on a
+    deterministic step; the overlapped attribution is covered in
+    tests/test_prefetch.py."""
     from raft_tpu.config import RAFTConfig, TrainConfig
     from raft_tpu.train import loop as loop_mod
 
@@ -307,7 +312,8 @@ def test_loop_data_wait_and_no_per_step_sync(tmp_path, monkeypatch,
     def run(name, telemetry_dir):
         cfg = TrainConfig(name=name, num_steps=4, batch_size=8,
                           image_size=(32, 32), iters=2, val_freq=100,
-                          log_freq=2, ckpt_dir=str(tmp_path / name))
+                          log_freq=2, ckpt_dir=str(tmp_path / name),
+                          device_prefetch=0)
         _SyncSpy.calls = 0
         loop_mod.train(mcfg, cfg,
                        _slow_batches(10, 8, (32, 32), slow_steps=(2,)),
@@ -332,11 +338,12 @@ def test_loop_data_wait_and_no_per_step_sync(tmp_path, monkeypatch,
     steps = {r["step"]: r for r in recs if r["event"] == "train_step"}
     assert sorted(steps) == [0, 1, 2, 3]
     for r in steps.values():
-        assert r["step_time_s"] >= r["data_wait_s"] >= 0
+        assert r["step_time_s"] >= r["queue_wait_s"] >= 0
+        assert r["h2d_s"] >= 0 and r["prep_s"] >= 0
         assert r["pairs_per_sec_per_chip"] > 0
     # the slow fetch before step 2 is caught by the input-bound detector
-    assert steps[2]["data_wait_s"] >= 0.04
-    assert steps[3]["data_wait_s"] < 0.04
+    assert steps[2]["queue_wait_s"] >= 0.04
+    assert steps[3]["queue_wait_s"] < 0.04
 
     # JSONL -> bench.py JSON (same schema + metric-name mapping).
     spec = importlib.util.spec_from_file_location(
@@ -348,7 +355,8 @@ def test_loop_data_wait_and_no_per_step_sync(tmp_path, monkeypatch,
     assert out["metric"] == "train_throughput_custom_32x32_bf16_iters12"
     assert out["unit"] == "image-pairs/sec/chip" and out["value"] > 0
     assert out["config"]["steps_measured"] == 2
-    assert 0 <= out["config"]["data_wait_frac"] <= 1
+    assert 0 <= out["config"]["queue_wait_frac"] <= 1
+    assert 0 <= out["config"]["h2d_frac"] <= 1
 
 
 def test_loop_telemetry_disabled_by_default(tmp_path, monkeypatch):
