@@ -15,8 +15,12 @@ flow is float32 and PNG-style encodings lose the sign/scale):
 - ``GET /metrics``   Prometheus text exposition rendered from the same
   engine registry ``/v1/stats`` reads (docs/OBSERVABILITY.md has the
   metric catalog) — point a Prometheus scrape job here.
-- ``GET /v1/healthz`` (alias ``/healthz``)  200 once the engine accepts
-  traffic.
+- ``GET /v1/healthz`` (alias ``/healthz``)  readiness, not just
+  liveness: 200 ``ok`` while the engine accepts traffic AND the device
+  worker is making progress; 503 + JSON detail (pending count, seconds
+  since the last completed device batch) when requests are pending but
+  no batch has completed within ``--stall-timeout-s`` — the serve-side
+  stall signal a balancer should drain on.
 
 Example client::
 
@@ -66,6 +70,12 @@ def parse_args(argv=None):
                         "first request (latency/throughput knob)")
     p.add_argument("--max-queue", type=int, default=256,
                    help="in-flight bound; beyond it requests get 429")
+    p.add_argument("--stall-timeout-s", type=float, default=120.0,
+                   help="readiness threshold: with requests pending and "
+                        "no device batch completed for this long, "
+                        "GET /v1/healthz turns 503 (must exceed "
+                        "max-wait-ms + worst cold compile, or warm up "
+                        "first; 0 disables)")
     p.add_argument("--buckets", default=None,
                    help="comma-separated /8-aligned HxW bucket ladder "
                         "(e.g. 440x1024,720x1280); default: exact /8 "
@@ -117,7 +127,11 @@ def _make_handler(engine):
 
         def do_GET(self):
             if self.path in ("/healthz", "/v1/healthz"):
-                self._reply(200, b"ok", "text/plain")
+                h = engine.health()
+                if h["ready"]:
+                    self._reply(200, b"ok", "text/plain")
+                else:  # readiness: drain this replica
+                    self._reply_json(503, h)
             elif self.path == "/v1/stats":
                 self._reply_json(200, engine.stats())
             elif self.path == "/metrics":
@@ -197,7 +211,8 @@ def main(argv=None):
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
         buckets=_parse_hw_list(args.buckets) if args.buckets else None,
         batch_sizes=tuple(int(b) for b in args.batch_sizes.split(","))
-        if args.batch_sizes else None)
+        if args.batch_sizes else None,
+        stall_timeout_s=max(args.stall_timeout_s, 0.0))
     sink = None
     if args.telemetry_dir:
         from raft_tpu.obs import EventSink

@@ -135,6 +135,36 @@ def parse_args(argv=None):
                         "0 = the serial fetch->prep->put->step path "
                         "(A/B; the batch stream is bit-identical "
                         "either way)")
+    p.add_argument("--nonfinite_guard", "--nonfinite-guard", type=int,
+                   default=1, choices=[0, 1],
+                   help="in-graph non-finite step guard: an isfinite "
+                        "reduction over loss+grads gates the optimizer "
+                        "update, so a poisoned step (bf16 overflow, "
+                        "corrupt batch) leaves params untouched, bumps "
+                        "the TrainState nonfinite_steps counter and "
+                        "triggers a forensic bundle at log cadence "
+                        "(docs/OBSERVABILITY.md); 0 = unguarded A/B")
+    p.add_argument("--forensic_keep", "--forensic-keep", type=int,
+                   default=8,
+                   help="host batches kept in the forensics ring; a "
+                        "non-finite step whose batch is still ringed "
+                        "gets a fully replayable bundle "
+                        "(scripts/replay_step.py).  Guaranteed capture "
+                        "needs log_freq <= this; 0 disables batch "
+                        "capture")
+    p.add_argument("--watchdog_timeout", "--watchdog-timeout",
+                   type=float, default=0.0, metavar="SECONDS",
+                   help="stall watchdog: seconds without a training-"
+                        "loop heartbeat before dumping all thread "
+                        "stacks and emitting a `stall` telemetry event "
+                        "(0 = off).  Pick ~20x the median step time "
+                        "and above startup compile; paused around "
+                        "save/validate")
+    p.add_argument("--watchdog_exit", "--watchdog-exit",
+                   action="store_true",
+                   help="hard-exit (code 42) when the watchdog fires, "
+                        "so a hung multi-host job fails fast instead "
+                        "of burning the pod")
     p.add_argument("--shard_spatial", type=int, default=1, metavar="N",
                    help="shard activations (image height) over N mesh "
                         "devices in addition to data parallelism — for "
@@ -262,6 +292,10 @@ def main(argv=None):
         accum_steps=args.accum_steps,
         prefetch_batches=args.prefetch_batches,
         device_prefetch=args.device_prefetch,
+        nonfinite_guard=bool(args.nonfinite_guard),
+        forensic_keep=max(args.forensic_keep, 0),
+        watchdog_timeout=max(args.watchdog_timeout, 0.0),
+        watchdog_exit=args.watchdog_exit,
         ckpt_dir=args.ckpt_dir)
     dataset = fetch_dataset(args.stage, tuple(args.image_size),
                             root=args.data_root,
@@ -334,6 +368,15 @@ def main(argv=None):
 
         signal.signal(signal.SIGTERM,
                       lambda signum, frame: request_preemption())
+
+    # On-demand "where is it stuck": SIGQUIT (kill -QUIT <pid>) appends
+    # an all-thread faulthandler stack dump to the same per-process file
+    # the stall watchdog writes (telemetry dir; stderr when telemetry is
+    # off) — inspect a wedged run without killing it.
+    from raft_tpu.obs.watchdog import install_sigquit_dump, stack_dump_path
+
+    install_sigquit_dump(stack_dump_path(
+        args.telemetry_dir or os.environ.get("RAFT_TELEMETRY_DIR")))
 
     train(model_cfg, cfg, loader=loader, validators=validators or None,
           restore_params=restore, tensorboard_dir=args.tensorboard_dir,

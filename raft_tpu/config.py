@@ -316,6 +316,31 @@ class TrainConfig:
     # N+1 overlaps the device step on batch N.  0 = the fully serial
     # fetch->prep->put->step path (for A/B); 2 = double buffering.
     device_prefetch: int = 2
+    # Non-finite step guard (raft_tpu/obs/health.py): an in-graph
+    # isfinite reduction over loss+grads gates the optimizer update —
+    # a poisoned step (bf16 overflow, corrupt batch) leaves
+    # params/opt_state untouched, bumps the nonfinite_steps counter in
+    # TrainState, and flags the step's metrics for host-side forensics.
+    # Pure device-side select; no extra syncs.  Off restores the
+    # unguarded update (A/B; a NaN then destroys the params, as before).
+    nonfinite_guard: bool = True
+    # Host batches kept in the forensics ring (the most recent N steps'
+    # post-noise inputs).  A step flagged non-finite whose batch is
+    # still in the ring gets a fully replayable bundle; older ones get
+    # step/rng/metrics only.  Guaranteed capture needs
+    # log_freq <= forensic_keep (the flag is observed at Logger
+    # cadence).  0 disables batch capture (bundles still written).
+    forensic_keep: int = 8
+    # Stall watchdog (raft_tpu/obs/watchdog.py): seconds without a
+    # training-loop heartbeat before dumping all thread stacks and
+    # emitting a `stall` telemetry event.  0 = off (default).  Pick
+    # ~20x the rolling median step time, and above startup
+    # trace+compile; the loop pauses it around save/validate.
+    watchdog_timeout: float = 0.0
+    # Hard-exit the process when the watchdog fires (exit code 42), so
+    # a hung multi-host job fails fast and gets rescheduled instead of
+    # burning a pod.  Off: dump + event only.
+    watchdog_exit: bool = False
     ckpt_dir: str = "checkpoints"
     # Number of data-parallel shards (devices); resolved at runtime.
     num_devices: int = 0

@@ -73,7 +73,7 @@ class DevicePipeline:
     def __init__(self, batches: Iterable, *,
                  put_fn: Optional[Callable] = None,
                  prep_fn: Optional[Callable] = None,
-                 depth: int = 2):
+                 depth: int = 2, keep_host: bool = False):
         if depth < 0:
             raise ValueError(f"device-prefetch depth must be >= 0, "
                              f"got {depth}")
@@ -81,9 +81,17 @@ class DevicePipeline:
         self._put = put_fn if put_fn is not None else (lambda b: b)
         self._prep = prep_fn
         self.depth = int(depth)
+        # keep_host: retain the post-prep HOST batch alongside each
+        # device batch (``last_host_batch`` after next()) — the
+        # forensics ring needs the exact host arrays the poisoned step
+        # consumed (raft_tpu/obs/health.py).  Off by default: holding
+        # the references keeps up to depth+ring batches of host RAM
+        # alive that the serial path would have freed.
+        self.keep_host = bool(keep_host)
         # Per-batch producer spans, valid right after next() returns.
         self.last_prep_s = 0.0
         self.last_h2d_s = 0.0
+        self.last_host_batch = None
         # Cumulative, for the input microbench / pipeline stats.
         self.prep_total_s = 0.0
         self.h2d_total_s = 0.0
@@ -114,17 +122,18 @@ class DevicePipeline:
                 try:
                     batch = next(self._src)
                 except StopIteration:
-                    self._q.put((_END, None, 0.0, 0.0))
+                    self._q.put((_END, None, None, 0.0, 0.0))
                     return
                 t0 = time.perf_counter()
                 if self._prep is not None:
                     batch = self._prep(batch)
                 t1 = time.perf_counter()
+                host = batch if self.keep_host else None
                 batch = self._put(batch)
                 t2 = time.perf_counter()
-                self._q.put((_ITEM, batch, t1 - t0, t2 - t1))
+                self._q.put((_ITEM, batch, host, t1 - t0, t2 - t1))
         except BaseException as e:  # re-raised in the consumer
-            self._q.put((_ERROR, e, 0.0, 0.0))
+            self._q.put((_ERROR, e, None, 0.0, 0.0))
 
     # -- consumer --------------------------------------------------------
     def __iter__(self) -> "DevicePipeline":
@@ -148,11 +157,12 @@ class DevicePipeline:
             if self._prep is not None:
                 batch = self._prep(batch)
             t1 = time.perf_counter()
+            self.last_host_batch = batch if self.keep_host else None
             batch = self._put(batch)
             t2 = time.perf_counter()
             self._account(t1 - t0, t2 - t1)
             return batch
-        kind, payload, prep_s, h2d_s = self._q.get()
+        kind, payload, host, prep_s, h2d_s = self._q.get()
         if kind == _END:
             self._closed = True
             raise StopIteration
@@ -160,6 +170,7 @@ class DevicePipeline:
             self._closed = True
             raise payload
         self._slots.release()
+        self.last_host_batch = host
         self._account(prep_s, h2d_s)
         return payload
 

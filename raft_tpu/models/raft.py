@@ -418,7 +418,11 @@ class RAFT(nn.Module):
     def _loss_outputs(sums, gt128, vmask64, B):
         """Normalize the per-iteration ``(iters, 5)`` partial sums into
         per-iteration mean losses + final-iteration metrics (reference
-        sequence_loss semantics, train.py:47-72)."""
+        sequence_loss semantics, train.py:47-72).  The per-iteration EPE
+        sums the scan already produced become the refinement-convergence
+        curve (``epe_iter``, docs/OBSERVABILITY.md) for free — no extra
+        compute, it rides the metrics dict to the host at Logger
+        cadence."""
         _, H8s, W8s, _ = gt128.shape
         n_all = B * H8s * W8s * 128              # loss mean incl. zeroed
         n_valid = jnp.maximum(jnp.sum(vmask64), 1.0)
@@ -426,7 +430,8 @@ class RAFT(nn.Module):
         metrics = {"epe": sums[-1, 1] / n_valid,
                    "1px": sums[-1, 2] / n_valid,
                    "3px": sums[-1, 3] / n_valid,
-                   "5px": sums[-1, 4] / n_valid}
+                   "5px": sums[-1, 4] / n_valid,
+                   "epe_iter": sums[:, 1] / n_valid}
         return per_iter, metrics
 
     def _fused_inscan_losses(self, cfg, iters, net, inp, coords0, coords1,
@@ -479,12 +484,18 @@ class RAFT(nn.Module):
 
         flow_gt, valid, max_flow = loss_targets
         vmask = combined_valid(flow_gt, valid, max_flow)
+        n_valid = jnp.maximum(jnp.sum(vmask), 1.0)
 
         def body(carry, flow):
             fu = upflow8(flow)
             loss = jnp.mean(vmask[..., None] * jnp.abs(fu - flow_gt))
-            return fu, loss
+            diff = jax.lax.stop_gradient(fu - flow_gt)  # metric: no grad
+            epe = jnp.sum(vmask * jnp.sqrt(jnp.sum(diff ** 2, -1))
+                          ) / n_valid
+            return fu, (loss, epe)
 
-        last_flow, per_iter = jax.lax.scan(
+        last_flow, (per_iter, epe_iter) = jax.lax.scan(
             body, jnp.zeros(flow_gt.shape, jnp.float32), flows)
-        return per_iter, flow_metrics(last_flow, flow_gt, vmask)
+        metrics = dict(flow_metrics(last_flow, flow_gt, vmask),
+                       epe_iter=epe_iter)
+        return per_iter, metrics

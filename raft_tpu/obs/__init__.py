@@ -14,6 +14,13 @@ Three composable pieces, shared by train/eval/serve:
 
 Hot-path contract: recording is lock-cheap, never forces a device
 sync, and the whole layer is a no-op when disabled.
+
+Training health lives in the sibling modules (imported directly, not
+re-exported, to keep this package import light): ``obs.health`` — the
+in-graph non-finite guard helpers, the host-side :class:`HealthMonitor`
+and forensic bundles — and ``obs.watchdog`` — the stall
+:class:`StallWatchdog` and the SIGQUIT stack dump
+(docs/OBSERVABILITY.md → "Training health").
 """
 
 from raft_tpu.obs.events import (

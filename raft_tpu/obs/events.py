@@ -59,6 +59,12 @@ class EventSink:
     def enabled(self) -> bool:
         return self._dir is not None
 
+    @property
+    def directory(self) -> Optional[str]:
+        """The sink's directory (None when disabled) — sibling artifacts
+        (forensic bundles, stack dumps) land next to the JSONL."""
+        return self._dir
+
     def _ensure_open(self):
         if self._fh is None:
             os.makedirs(self._dir, exist_ok=True)

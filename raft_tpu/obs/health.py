@@ -1,0 +1,213 @@
+"""Training-health: in-graph numerics helpers + the host-side monitor.
+
+Two halves, matching the two sides of the zero-added-sync contract
+(docs/OBSERVABILITY.md):
+
+- **In-graph** (:func:`tree_all_finite`, :func:`tree_select`): the
+  pieces ``make_train_step`` uses to gate the optimizer update on a
+  ``jnp.isfinite`` reduction over loss+grads.  A poisoned step (bf16
+  overflow, corrupt batch, lr spike) leaves params/opt_state bit-
+  identical, bumps the ``nonfinite_steps`` counter carried in
+  ``TrainState``, and flags the step's metrics dict — all device-side,
+  no host round-trip.
+- **Host-side** (:class:`HealthMonitor`): fed by the training
+  ``Logger``'s once-per-interval flush (the ONLY device->host metric
+  transfer the loop makes), it mirrors the numerics metrics into the
+  registry (``raft_train_param_norm`` / ``raft_train_update_ratio`` /
+  ``raft_train_epe_iter{iter}`` / ``raft_train_nonfinite_steps_total``),
+  emits a ``train_health`` JSONL record per flush, and — when a flushed
+  interval contains a flagged step — writes a **forensic bundle**
+  (offending host batch + step + RNG seed + metrics + configs) under
+  ``telemetry_dir/forensics/`` that ``scripts/replay_step.py`` can
+  re-run offline against a checkpoint to reproduce the blow-up.
+
+The monitor keeps a bounded ring of the most recent host batches
+(``TrainConfig.forensic_keep``); a flagged step older than the ring at
+flush time still gets a bundle (step/rng/metrics) with
+``batch_captured: false`` — set ``log_freq <= forensic_keep`` when you
+need guaranteed capture.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------
+# in-graph helpers (used by raft_tpu/train/step.py)
+# ---------------------------------------------------------------------
+
+def tree_all_finite(tree) -> jax.Array:
+    """Scalar bool: every element of every inexact leaf is finite.
+
+    Integer/bool leaves are skipped (``isfinite`` is undefined there and
+    counters are finite by construction)."""
+    oks = [jnp.all(jnp.isfinite(leaf))
+           for leaf in jax.tree_util.tree_leaves(tree)
+           if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)]
+    if not oks:
+        return jnp.asarray(True)
+    out = oks[0]
+    for ok in oks[1:]:
+        out = jnp.logical_and(out, ok)
+    return out
+
+
+def tree_select(pred, on_true, on_false):
+    """Per-leaf ``where(pred, ...)`` over two same-structure pytrees.
+
+    The guard's update gate: both branches are computed (XLA selects,
+    it does not branch on TPU) and every leaf — params, opt_state
+    moments, int step counters — takes the ``on_true`` value iff the
+    scalar ``pred`` is True."""
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), on_true, on_false)
+
+
+# ---------------------------------------------------------------------
+# forensic bundles
+# ---------------------------------------------------------------------
+
+_BATCH_KEYS = ("image1", "image2", "flow", "valid")
+
+
+def forensic_bundle_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step{int(step):08d}.npz")
+
+
+def write_forensic_bundle(directory: str, step: int,
+                          batch: Optional[Dict[str, np.ndarray]],
+                          meta: Dict) -> str:
+    """One self-contained ``.npz``: the (post-noise) host batch arrays
+    plus a ``__meta__`` JSON blob (step, seed, per-step metrics, model +
+    train config dicts).  ``batch=None`` still writes the record with
+    ``batch_captured: false`` so the event is never silently lost."""
+    os.makedirs(directory, exist_ok=True)
+    path = forensic_bundle_path(directory, step)
+    meta = dict(meta, step=int(step), batch_captured=batch is not None)
+    arrays = {}
+    if batch is not None:
+        arrays = {k: np.asarray(v) for k, v in batch.items()}
+    np.savez(path, __meta__=np.asarray(json.dumps(meta, default=str)),
+             **arrays)
+    return path
+
+
+def load_forensic_bundle(path: str):
+    """``(batch_or_None, meta)`` from a bundle written above."""
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        batch = None
+        if meta.get("batch_captured"):
+            batch = {k: z[k] for k in z.files if k != "__meta__"}
+    return batch, meta
+
+
+# ---------------------------------------------------------------------
+# host-side monitor (fed by Logger.on_flush — no extra device syncs)
+# ---------------------------------------------------------------------
+
+def _scalar(metrics: Dict, key: str) -> Optional[float]:
+    v = metrics.get(key)
+    if v is None:
+        return None
+    v = np.asarray(v)
+    return float(v) if v.ndim == 0 else None
+
+
+def _vector(metrics: Dict, key: str) -> Optional[List[float]]:
+    v = metrics.get(key)
+    if v is None:
+        return None
+    v = np.asarray(v, np.float64)
+    return [float(x) for x in np.ravel(v)]
+
+
+class HealthMonitor:
+    """Observes the Logger's per-interval flush; writes forensics.
+
+    Everything it receives is already host-side numpy (converted by the
+    Logger's single per-interval transfer), so by construction it adds
+    zero device syncs to the step path — the same contract as
+    :class:`raft_tpu.obs.train.TrainTelemetry`, which it drives."""
+
+    def __init__(self, telemetry, *, forensics_dir: Optional[str] = None,
+                 seed: int = 0, keep: int = 8,
+                 initial_nonfinite: int = 0,
+                 run_meta: Optional[Dict] = None):
+        self.telemetry = telemetry
+        self.forensics_dir = forensics_dir
+        self.seed = int(seed)
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(int(keep), 1))
+        self.nonfinite_total = int(initial_nonfinite)
+        self.run_meta = run_meta or {}
+        self.bundles: List[str] = []   # paths written this run
+
+    def note_batch(self, step: int, host_batch) -> None:
+        """Remember the host batch about to be consumed by ``step``
+        (a reference append — no copies, no device access)."""
+        if self.forensics_dir is not None and host_batch is not None:
+            self._ring.append((int(step), host_batch))
+
+    def observe_flush(self, first_step: int, means: Dict,
+                      per_step: List[Dict]) -> None:
+        """Logger flush hook: per-step metrics (host numpy) for steps
+        ``first_step .. first_step+len(per_step)-1``."""
+        if not per_step:
+            return
+        flagged = [first_step + i for i, m in enumerate(per_step)
+                   if float(np.asarray(m.get("nonfinite", 0.0))) > 0.5]
+        self.nonfinite_total += len(flagged)
+        last = per_step[-1]
+        self.telemetry.record_health(
+            first_step + len(per_step) - 1,
+            param_norm=_scalar(last, "param_norm"),
+            update_ratio=_scalar(last, "update_ratio"),
+            epe_iter=_vector(last, "epe_iter"),
+            loss_iter=_vector(last, "loss_iter"),
+            nonfinite_new=len(flagged),
+            nonfinite_total=self.nonfinite_total)
+        for step in flagged:
+            self._capture(step, per_step[step - first_step])
+
+    # -- forensics -----------------------------------------------------
+
+    def _capture(self, step: int, metrics: Dict) -> None:
+        if self.forensics_dir is None:
+            return
+        batch = next((b for (s, b) in self._ring if s == step), None)
+        meta = {
+            "seed": self.seed,
+            # The step RNG is fold_in(PRNGKey(seed), step) — recorded as
+            # (seed, step) so replay_step.py re-derives the exact key.
+            "rng": {"kind": "fold_in(PRNGKey(seed), step)",
+                    "seed": self.seed, "step": int(step)},
+            "metrics": {k: np.asarray(v).tolist()
+                        for k, v in metrics.items()},
+        }
+        meta.update(self.run_meta)
+        try:
+            path = write_forensic_bundle(self.forensics_dir, step, batch,
+                                         meta)
+        except Exception as e:  # forensics must never kill the run
+            print(f"WARNING: forensic bundle for step {step} failed "
+                  f"({type(e).__name__}: {e})", flush=True)
+            return
+        self.bundles.append(path)
+        self.telemetry.sink.emit(
+            "nonfinite_step", step=step, bundle=path,
+            batch_captured=batch is not None,
+            nonfinite_steps_total=self.nonfinite_total)
+        print(f"WARNING: non-finite loss/grads at step {step}; update "
+              f"skipped by the guard; forensic bundle: {path}"
+              + ("" if batch is not None else
+                 " (batch already evicted — raise forensic_keep or "
+                 "lower log_freq to capture it)"), flush=True)

@@ -39,8 +39,9 @@ same JSONL file as its per-step stream.
 
 from __future__ import annotations
 
+import collections
 import os
-from typing import Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from raft_tpu.obs.events import EventSink
 from raft_tpu.obs.registry import MetricRegistry
@@ -80,6 +81,35 @@ class TrainTelemetry:
         self._pps = self.registry.gauge(
             "raft_train_pairs_per_sec_per_chip",
             "batch / step_time / num_devices, last step")
+        # Training-health metrics (docs/OBSERVABILITY.md "Training
+        # health"): fed by HealthMonitor from the Logger's once-per-
+        # interval flush — host floats only, never a device sync.
+        self._param_norm = self.registry.gauge(
+            "raft_train_param_norm",
+            "global L2 norm of all parameters, last logged step")
+        self._update_ratio = self.registry.gauge(
+            "raft_train_update_ratio",
+            "global update-norm / param-norm of the optimizer step, "
+            "last logged step (a spike = one step rewriting the net)")
+        self._nonfinite = self.registry.counter(
+            "raft_train_nonfinite_steps_total",
+            "steps whose loss/grads were non-finite (update skipped by "
+            "the in-graph guard)")
+        self._epe_iter = self.registry.gauge(
+            "raft_train_epe_iter",
+            "per-refinement-iteration EPE of the last logged step "
+            "(iter label; the refinement-convergence curve)")
+        # Recent per-step records for the stall watchdog's post-mortem.
+        self._recent: collections.deque = collections.deque(maxlen=16)
+
+    @property
+    def directory(self) -> Optional[str]:
+        """The resolved telemetry directory (None = disabled)."""
+        return self.sink.directory
+
+    def recent_records(self) -> List[dict]:
+        """The last few train_step records (stall-event payload)."""
+        return list(self._recent)
 
     def start(self, start_step: int, num_steps: int) -> None:
         if not self.enabled:
@@ -102,12 +132,48 @@ class TrainTelemetry:
         self._h2d_hist.observe(h2d_s)
         self._prep_hist.observe(prep_s)
         self._pps.set(pps)
-        self.sink.emit("train_step", step=step,
-                       step_time_s=round(step_time_s, 6),
-                       queue_wait_s=round(queue_wait_s, 6),
-                       h2d_s=round(h2d_s, 6),
-                       prep_s=round(prep_s, 6),
-                       pairs_per_sec_per_chip=round(pps, 3))
+        rec = dict(step=step,
+                   step_time_s=round(step_time_s, 6),
+                   queue_wait_s=round(queue_wait_s, 6),
+                   h2d_s=round(h2d_s, 6),
+                   prep_s=round(prep_s, 6),
+                   pairs_per_sec_per_chip=round(pps, 3))
+        self._recent.append(rec)
+        self.sink.emit("train_step", **rec)
+
+    def record_health(self, step: int, *,
+                      param_norm: Optional[float] = None,
+                      update_ratio: Optional[float] = None,
+                      epe_iter: Optional[Sequence[float]] = None,
+                      loss_iter: Optional[Sequence[float]] = None,
+                      nonfinite_new: int = 0,
+                      nonfinite_total: int = 0) -> None:
+        """One per-Logger-flush health record: numerics gauges + the
+        refinement-convergence curve + the non-finite counter.  All
+        inputs are host floats already pulled by the Logger's single
+        interval transfer (HealthMonitor is the only caller)."""
+        if not self.enabled:
+            return
+        if param_norm is not None:
+            self._param_norm.set(param_norm)
+        if update_ratio is not None:
+            self._update_ratio.set(update_ratio)
+        if epe_iter is not None:
+            for i, v in enumerate(epe_iter):
+                self._epe_iter.set(float(v), iter=f"{i:02d}")
+        if nonfinite_new:
+            self._nonfinite.inc(nonfinite_new)
+        fields = {"nonfinite_steps_total": int(nonfinite_total),
+                  "nonfinite_in_interval": int(nonfinite_new)}
+        if param_norm is not None:
+            fields["param_norm"] = round(float(param_norm), 6)
+        if update_ratio is not None:
+            fields["update_ratio"] = round(float(update_ratio), 8)
+        if epe_iter is not None:
+            fields["epe_iter"] = [round(float(v), 5) for v in epe_iter]
+        if loss_iter is not None:
+            fields["loss_iter"] = [round(float(v), 6) for v in loss_iter]
+        self.sink.emit("train_health", step=step, **fields)
 
     def record_compile(self, step: int, seconds: float, key) -> None:
         """First dispatch of a jitted step signature: trace+compile

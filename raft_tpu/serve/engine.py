@@ -83,7 +83,12 @@ class ServeConfig:
     traffic a coarse ladder coalesces nearby resolutions into one
     program instead of fragmenting per shape.  ``batch_sizes`` is the
     set of compiled batch shapes (default: powers of two up to
-    ``max_batch``); micro-batches round up to the nearest one."""
+    ``max_batch``); micro-batches round up to the nearest one.
+    ``stall_timeout_s``: readiness threshold — with requests pending
+    and no device batch completed for this long, ``health()`` reports
+    not-ready (``GET /v1/healthz`` -> 503) so a balancer drains a
+    wedged replica; must exceed ``max_wait_ms`` + the worst cold
+    compile (or warm up first); 0 disables the check."""
 
     iters: int = 32
     max_batch: int = 8
@@ -94,12 +99,15 @@ class ServeConfig:
     batch_sizes: Optional[Tuple[int, ...]] = None
     pad_mode: str = "sintel"
     latency_window: int = 4096
+    stall_timeout_s: float = 120.0
 
     def __post_init__(self):
         if self.max_batch < 1 or self.max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         if self.max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
+        if self.stall_timeout_s < 0:
+            raise ValueError("stall_timeout_s must be >= 0")
         m = self.bucket_multiple
         for hw in self.buckets or ():
             if hw[0] % m or hw[1] % m:
@@ -185,6 +193,15 @@ class InferenceEngine:
 
         self._pending = 0
         self._pending_lock = threading.Lock()
+        # Serve-side stall signal: perf_counter of the last COMPLETED
+        # device batch (success or failure — either proves the device
+        # worker is alive) and of start(); health() derives readiness.
+        self._last_batch_done: Optional[float] = None
+        self._t_started: Optional[float] = None
+        self._stale_gauge = self.registry.gauge(
+            "raft_serve_seconds_since_last_batch",
+            "seconds since the last completed device batch (refreshed "
+            "at scrape; absent before the first batch)")
 
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -214,6 +231,7 @@ class InferenceEngine:
         self._thread.start()
         started.wait()
         self._counters.mark_started()
+        self._t_started = time.perf_counter()
         self._accepting = True
         return self
 
@@ -315,7 +333,39 @@ class InferenceEngine:
     def _collect_pending(self, _reg) -> None:
         with self._pending_lock:
             pending = self._pending
+            last = self._last_batch_done
         self._pending_gauge.set(pending)
+        if last is not None:
+            self._stale_gauge.set(time.perf_counter() - last)
+
+    def health(self) -> dict:
+        """Readiness snapshot (``GET /v1/healthz``).
+
+        Liveness alone ("the HTTP thread answers") misses the real
+        failure mode: a wedged device worker with requests piling up.
+        Not-ready ⇔ accepting is off, OR requests are pending and no
+        device batch has completed within ``stall_timeout_s`` (measured
+        from the last completed batch, or from ``start()`` when none
+        has completed yet)."""
+        now = time.perf_counter()
+        with self._pending_lock:
+            pending = self._pending
+            last = self._last_batch_done
+        since = None if last is None else now - last
+        stalled = False
+        if self.cfg.stall_timeout_s and pending > 0:
+            ref = last if last is not None else self._t_started
+            stalled = (ref is not None
+                       and now - ref > self.cfg.stall_timeout_s)
+        return {
+            "ready": bool(self._accepting and not stalled),
+            "accepting": bool(self._accepting),
+            "stalled": stalled,
+            "pending": pending,
+            "seconds_since_last_batch":
+                None if since is None else round(since, 3),
+            "stall_timeout_s": self.cfg.stall_timeout_s,
+        }
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the engine registry (the same
@@ -442,3 +492,4 @@ class InferenceEngine:
         finally:
             with self._pending_lock:
                 self._pending -= len(reqs)
+                self._last_batch_done = time.perf_counter()

@@ -44,11 +44,35 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def restore_latest(self, template: TrainState) -> Optional[TrainState]:
-        """Full-state restore for preemption recovery; None if no ckpt."""
+        """Full-state restore for preemption recovery; None if no ckpt.
+
+        Checkpoints written before the non-finite guard lack the
+        ``nonfinite_steps`` counter; a structure-mismatch restore is
+        retried against a counter-less template and the counter
+        re-attached at zero, so old run directories resume cleanly."""
         step = self._mgr.latest_step()
         if step is None:
             return None
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+        has_counter = getattr(template, "nonfinite_steps", None) is not None
+        try:
+            st = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template))
+        except Exception:
+            # Stricter orbax versions raise on the structure mismatch;
+            # retry against the legacy (counter-less) template.
+            if not has_counter:
+                raise
+            st = self._mgr.restore(
+                step,
+                args=ocp.args.StandardRestore(
+                    template.replace(nonfinite_steps=None)))
+        if has_counter and getattr(st, "nonfinite_steps", None) is None:
+            # Lenient orbax restores the absent leaf as None — either
+            # way the counter restarts at zero.
+            import jax.numpy as jnp
+
+            st = st.replace(nonfinite_steps=jnp.zeros((), jnp.int32))
+        return st
 
     def restore_params(self, template: TrainState) -> Optional[Any]:
         """Weights(+batch_stats)-only restore: seeds the next curriculum

@@ -3,11 +3,19 @@
 Running means printed every ``log_freq`` steps with step + current LR, and
 mirrored to TensorBoard when available.  Metric device->host transfers are
 batched per log interval, never per step — the step loop stays async.
+
+Vector metrics (the per-iteration ``loss_iter``/``epe_iter`` curves)
+ride the same buffered transfer: the printed line keeps scalars only,
+TensorBoard gets one ``<name>/<ii>`` scalar per element, and the
+``on_flush`` hook receives the full per-step host arrays — this is the
+single device->host transfer the training-health layer
+(``raft_tpu/obs/health.py``) feeds on, so numerics telemetry adds zero
+syncs by construction.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -15,9 +23,15 @@ import numpy as np
 class Logger:
     def __init__(self, log_freq: int = 100,
                  lr_fn: Optional[Callable[[int], float]] = None,
-                 tensorboard_dir: Optional[str] = None):
+                 tensorboard_dir: Optional[str] = None,
+                 on_flush: Optional[Callable[[int, Dict, List[Dict]],
+                                             None]] = None):
         self.log_freq = log_freq
         self.lr_fn = lr_fn
+        # on_flush(first_step, means, per_step): called once per flush
+        # with the interval's converted (host numpy) metrics —
+        # per_step[i] belongs to step first_step + i.
+        self.on_flush = on_flush
         self._pending: list = []  # device arrays; pulled once per interval
         self._last_step = 0
         self._writer = None
@@ -54,18 +68,37 @@ class Logger:
             return
         step = self._last_step
         count = len(self._pending)
-        sums: Dict[str, float] = {}
-        for m in self._pending:  # one sync per interval, not per step
+        # One sync per interval, not per step: each buffered device
+        # value is pulled exactly once; everything after this loop
+        # (means, print, TB, the on_flush health hook) reads the host
+        # copies.
+        per_step: List[Dict[str, np.ndarray]] = [
+            {k: np.asarray(v) for k, v in m.items()}
+            for m in self._pending]
+        sums: Dict[str, np.ndarray] = {}
+        for m in per_step:
             for k, v in m.items():
-                sums[k] = sums.get(k, 0.0) + float(np.asarray(v))
+                sums[k] = sums.get(k, 0.0) + np.asarray(v, np.float64)
         means = {k: s / count for k, s in sums.items()}
         lr = self.lr_fn(step) if self.lr_fn else float("nan")
-        body = ", ".join(f"{k} {v:10.4f}" for k, v in sorted(means.items()))
+        body = ", ".join(f"{k} {float(v):10.4f}"
+                         for k, v in sorted(means.items())
+                         if np.ndim(v) == 0)
         print(f"[{step + 1:6d}, {lr:10.7f}] {body}", flush=True)
         w = self._ensure_writer()
         if w is not None:
             for k, v in means.items():
-                w.add_scalar(k, v, step + 1)
+                if np.ndim(v) == 0:
+                    w.add_scalar(k, float(v), step + 1)
+                else:  # per-iteration curves: one series per element
+                    for i, vi in enumerate(np.ravel(v)):
+                        w.add_scalar(f"{k}/{i:02d}", float(vi), step + 1)
+        if self.on_flush is not None:
+            try:
+                self.on_flush(step - count + 1, means, per_step)
+            except Exception as e:  # health/forensics must never kill
+                print(f"WARNING: logger flush hook failed "
+                      f"({type(e).__name__}: {e})", flush=True)
         self._pending = []
 
     def write_dict(self, step: int, results: Dict[str, float]) -> None:

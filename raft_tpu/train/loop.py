@@ -21,6 +21,7 @@ TPU-first shape of the loop:
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 import time
@@ -32,7 +33,9 @@ import numpy as np
 from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.data.prefetch import DevicePipeline
 from raft_tpu.models.raft import RAFT
+from raft_tpu.obs.health import HealthMonitor
 from raft_tpu.obs.train import TrainTelemetry
+from raft_tpu.obs.watchdog import StallWatchdog, stack_dump_path
 from raft_tpu.parallel import make_batch_sharder, make_mesh
 from raft_tpu.train.checkpoint import CheckpointManager
 from raft_tpu.train.logger import Logger
@@ -159,8 +162,6 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
 
     step_fn = make_train_step(model, tx, cfg, mesh,
                               shard_spatial=shard_spatial)
-    logger = Logger(cfg.log_freq, lr_fn=schedule_of(cfg.lr, cfg.num_steps),
-                    tensorboard_dir=tensorboard_dir)
     key = jax.random.PRNGKey(cfg.seed)
 
     step = int(state.step)
@@ -176,18 +177,56 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
         noise_rng = np.random.default_rng(
             np.random.SeedSequence([cfg.seed + 1, step]))
         prep_fn = functools.partial(add_image_noise, noise_rng)
+    profiler = StepProfiler(profile_dir)
+    telem = TrainTelemetry(telemetry_dir, batch_size=cfg.batch_size,
+                           num_devices=max(jax.device_count(), 1),
+                           image_size=cfg.image_size)
+    telem.start(start_step=step, num_steps=cfg.num_steps)
+    # Training health (docs/OBSERVABILITY.md "Training health"): the
+    # monitor is fed by the Logger's once-per-interval flush — the only
+    # device->host metric transfer — and writes forensic bundles for
+    # guard-flagged steps.  Telemetry off = no monitor (the in-graph
+    # guard in make_train_step still protects the params regardless).
+    health = None
+    if telem.enabled:
+        initial_nonfinite = 0
+        if getattr(state, "nonfinite_steps", None) is not None:
+            # One scalar pull at startup (resume carries the lifetime
+            # counter in the checkpoint), never per step.
+            initial_nonfinite = int(jax.device_get(state.nonfinite_steps))
+        health = HealthMonitor(
+            telem,
+            forensics_dir=os.path.join(telem.directory, "forensics"),
+            seed=cfg.seed, keep=max(int(getattr(cfg, "forensic_keep", 8)),
+                                    0),
+            initial_nonfinite=initial_nonfinite,
+            run_meta={"model_cfg": dataclasses.asdict(model_cfg),
+                      "train_cfg": dataclasses.asdict(cfg)})
+    logger = Logger(cfg.log_freq, lr_fn=schedule_of(cfg.lr, cfg.num_steps),
+                    tensorboard_dir=tensorboard_dir,
+                    on_flush=health.observe_flush if health else None)
     # The overlapped input pipeline: decode (loader threads) -> host prep
     # (noise) -> async device_put, double/triple-buffered ahead of the
     # consuming step.  depth 0 = the old serial path, same batch stream.
     pipeline = DevicePipeline(
         batches, put_fn=make_batch_sharder(mesh, spatial=shard_spatial),
         prep_fn=prep_fn,
-        depth=max(int(getattr(cfg, "device_prefetch", 0)), 0))
-    profiler = StepProfiler(profile_dir)
-    telem = TrainTelemetry(telemetry_dir, batch_size=cfg.batch_size,
-                           num_devices=max(jax.device_count(), 1),
-                           image_size=cfg.image_size)
-    telem.start(start_step=step, num_steps=cfg.num_steps)
+        depth=max(int(getattr(cfg, "device_prefetch", 0)), 0),
+        keep_host=health is not None
+        and getattr(cfg, "forensic_keep", 8) > 0)
+    # Stall watchdog: per-iteration heartbeats; no heartbeat within
+    # cfg.watchdog_timeout -> all-thread stack dump + `stall` event
+    # (+ optional hard exit).  Paused around save/validate, whose
+    # minutes-long runtime is legitimate.
+    watchdog = None
+    wd_timeout = float(getattr(cfg, "watchdog_timeout", 0.0) or 0.0)
+    if wd_timeout > 0:
+        watchdog = StallWatchdog(
+            wd_timeout, sink=telem.sink,
+            dump_path=stack_dump_path(telem.directory),
+            hard_exit=bool(getattr(cfg, "watchdog_exit", False)),
+            recent_records=telem.recent_records)
+        watchdog.start()
     t0, steps_t0 = time.time(), step
     first_dispatched = False
     try:
@@ -198,6 +237,8 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             # consumer-side queue wait (near 0 when the producer keeps
             # up); at depth 0 it degrades to the full serial
             # fetch+prep+H2D cost — the old data_wait_s.
+            if watchdog is not None:
+                watchdog.beat(step)
             t_iter = time.perf_counter()
             try:
                 sharded = next(pipeline)
@@ -206,11 +247,21 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             queue_wait_s = time.perf_counter() - t_iter
             if step >= cfg.num_steps:
                 break
+            if health is not None:
+                # Reference append into the forensics ring (the host
+                # copy the pipeline retained) — no transfers, no copies.
+                health.note_batch(step, pipeline.last_host_batch)
             if (jax.process_count() == 1 and _PREEMPT.is_set()) or (
                     jax.process_count() > 1
                     and _reached_preemption_sync(step)):
                 raise SystemExit(143)  # step boundary; state is consistent
             profiler.maybe_start(step)
+            if watchdog is not None and not first_dispatched:
+                # The first dispatch trace+compiles synchronously —
+                # minutes, and legitimate; don't let it look like a
+                # stall (resumed below, after the hbm snapshot's own
+                # lower+compile).
+                watchdog.pause()
             with annotate_step(step):
                 state, metrics = step_fn(state, sharded, key)
             profiler.maybe_stop(step, sync_on=metrics.get("loss"))
@@ -235,6 +286,8 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                     # skips it).  Purely host-side, runs once.
                     telem.record_hbm(hbm_usage(step_fn, state, sharded,
                                                key))
+                if watchdog is not None:
+                    watchdog.resume()  # compile window over
             telem.record_step(step - 1, step_time_s, queue_wait_s,
                               h2d_s=pipeline.last_h2d_s,
                               prep_s=pipeline.last_prep_s)
@@ -254,6 +307,8 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                 raise SystemExit(143)
 
             if step % cfg.val_freq == 0:
+                if watchdog is not None:
+                    watchdog.pause()  # save+validate is legitimately slow
                 mgr.save(step, state)
                 if validators:
                     variables = {"params": state.params}
@@ -268,6 +323,8 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                 print(f"throughput: {ips:.2f} image-pairs/sec (host)",
                       flush=True)
                 t0, steps_t0 = time.time(), step
+                if watchdog is not None:
+                    watchdog.resume()
 
         if mgr.latest_step() != int(state.step):
             mgr.save(int(state.step), state, force=True)
@@ -288,6 +345,8 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             mgr.save(int(state.step), state, force=True)
         raise
     finally:
+        if watchdog is not None:
+            watchdog.stop()  # first: teardown below can be slow
         pipeline.close()
         mgr.wait()
         mgr.close()

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 
 
@@ -55,6 +56,13 @@ def sequence_loss(flow_preds: jnp.ndarray, flow_gt: jnp.ndarray,
       (train.py:51-52); like the reference, the per-iteration term is the
       mean over *all* pixels with invalid ones zeroed (train.py:58-59),
       not the mean over valid pixels.
+
+    Besides the reference's final-iteration metrics, the metrics dict
+    carries the refinement-convergence curve: ``loss_iter`` (the
+    unweighted per-iteration L1 terms, (iters,)) and ``epe_iter`` (the
+    per-iteration masked-mean EPE, (iters,)) — a healthy RAFT shows a
+    monotonically falling ``epe_iter``; a flat tail says the extra GRU
+    iterations buy nothing (docs/OBSERVABILITY.md).
     """
     n_predictions = flow_preds.shape[0]
     valid = combined_valid(flow_gt, valid, max_flow)
@@ -67,6 +75,15 @@ def sequence_loss(flow_preds: jnp.ndarray, flow_gt: jnp.ndarray,
     per_iter = jnp.mean(vmask * abs_err, axis=(1, 2, 3, 4))
     flow_loss = jnp.sum(weights * per_iter)
 
+    # Metrics need no gradient; stop_gradient keeps the sqrt's inf
+    # derivative at exactly-zero error out of any rematerialized
+    # backward (same reasoning as UpsampleLossStep, models/raft.py).
+    diff = jax.lax.stop_gradient(flow_preds - flow_gt[None])
+    epe_all = jnp.sqrt(jnp.sum(diff ** 2, axis=-1))       # (iters, B, H, W)
+    n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+    epe_iter = jnp.sum(valid[None] * epe_all, axis=(1, 2, 3)) / n_valid
+
     metrics = flow_metrics(flow_preds[-1], flow_gt,
                            valid.astype(jnp.float32))
+    metrics = dict(metrics, loss_iter=per_iter, epe_iter=epe_iter)
     return flow_loss, metrics

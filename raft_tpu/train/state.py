@@ -21,18 +21,34 @@ class TrainState:
     params: Any
     batch_stats: Any          # flax BatchNorm running stats ({} if none)
     opt_state: optax.OptState
+    # Lifetime count of steps whose loss/grads were non-finite and whose
+    # update the guard skipped (raft_tpu/obs/health.py).  Carried in the
+    # state so it survives checkpoint/resume; None on states built by
+    # pre-guard code (checkpoint.py re-attaches a zero on restore).
+    nonfinite_steps: Any = None
 
     def apply_gradients(self, grads, tx: optax.GradientTransformation,
-                        new_batch_stats=None) -> "TrainState":
+                        new_batch_stats=None, return_norms: bool = False):
         updates, new_opt = tx.update(grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
-        return self.replace(
+        new_state = self.replace(
             step=self.step + 1,
             params=new_params,
             batch_stats=(self.batch_stats if new_batch_stats is None
                          else new_batch_stats),
             opt_state=new_opt,
         )
+        if not return_norms:
+            return new_state
+        # Numerics-health taps on the optax update (in-graph; they ride
+        # the step's metrics dict to the host at Logger cadence):
+        # update_ratio ~1e-3 is a healthy Adam regime, a spike says the
+        # schedule/clip is letting one step rewrite the network.
+        param_norm = optax.global_norm(self.params)
+        update_norm = optax.global_norm(updates)
+        norms = {"param_norm": param_norm,
+                 "update_ratio": update_norm / (param_norm + 1e-12)}
+        return new_state, norms
 
     def param_count(self) -> int:
         """Total parameter count (the reference prints it at startup,

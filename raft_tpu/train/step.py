@@ -21,6 +21,7 @@ from jax.sharding import Mesh
 
 from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.models.raft import RAFT
+from raft_tpu.obs.health import tree_all_finite, tree_select
 from raft_tpu.parallel.mesh import (batch_sharding, replicated_sharding,
                                     spatial_batch_sharding)
 from raft_tpu.train.loss import sequence_loss
@@ -39,7 +40,48 @@ def init_state(model: RAFT, tx: optax.GradientTransformation,
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                      batch_stats=batch_stats, opt_state=tx.init(params))
+                      batch_stats=batch_stats, opt_state=tx.init(params),
+                      nonfinite_steps=jnp.zeros((), jnp.int32))
+
+
+def make_loss_fn(model: RAFT, cfg: TrainConfig) -> Callable:
+    """Build ``loss_fn(params, batch_stats, batch, rng) ->
+    (loss, (metrics, new_batch_stats))`` — the differentiated core of
+    :func:`make_train_step`, exposed so ``scripts/replay_step.py`` can
+    re-run a forensic bundle's exact step computation offline."""
+
+    def loss_fn(params, batch_stats, batch, rng):
+        variables = {"params": params}
+        mutable = False
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+            if not cfg.freeze_bn:
+                mutable = ["batch_stats"]
+        kwargs = dict(iters=cfg.iters, train=True, freeze_bn=cfg.freeze_bn,
+                      rngs={"dropout": rng}, mutable=mutable)
+        if cfg.fused_loss:
+            # Sequence loss fused into the scan: per-iteration scalars
+            # instead of stacked full-res flows (identical numerics at
+            # fp32; bf16-rounding-level difference when
+            # resolved_upsample_dtype is bfloat16).
+            kwargs["loss_targets"] = (batch["flow"], batch["valid"],
+                                      cfg.max_flow)
+        out = model.apply(variables, batch["image1"], batch["image2"],
+                          **kwargs)
+        out, new_vars = out if mutable else (out, {})
+        if cfg.fused_loss:
+            per_iter, metrics = out
+            i = jnp.arange(cfg.iters, dtype=per_iter.dtype)
+            weights = cfg.gamma ** (cfg.iters - i - 1.0)
+            loss = jnp.sum(weights * per_iter)
+            metrics = dict(metrics, loss_iter=per_iter)
+        else:
+            loss, metrics = sequence_loss(
+                out, batch["flow"], batch["valid"],
+                gamma=cfg.gamma, max_flow=cfg.max_flow)
+        return loss, (metrics, new_vars.get("batch_stats"))
+
+    return loss_fn
 
 
 def make_train_step(model: RAFT, tx: optax.GradientTransformation,
@@ -70,39 +112,21 @@ def make_train_step(model: RAFT, tx: optax.GradientTransformation,
     batch-stat *normalization* couples only within a microbatch, so use
     ``freeze_bn`` stages — every stage but chairs — for exact-parity
     needs); logged metrics are the mean of per-microbatch metrics.
+
+    Training health (``cfg.nonfinite_guard``, default on): an in-graph
+    ``isfinite`` reduction over loss+grads gates the update — a poisoned
+    step leaves params/opt_state/batch_stats bit-identical, bumps the
+    ``nonfinite_steps`` counter carried in ``TrainState``, and sets the
+    ``nonfinite`` metric flag the host observes at Logger cadence
+    (forensics: raft_tpu/obs/health.py).  The step also emits
+    ``param_norm`` / ``update_ratio`` (the optax-update tap) and the
+    per-iteration ``loss_iter``/``epe_iter`` curves — all riding the
+    existing metrics dict, zero added device syncs.
     """
 
-    def loss_fn(params, batch_stats, batch, rng):
-        variables = {"params": params}
-        mutable = False
-        if batch_stats:
-            variables["batch_stats"] = batch_stats
-            if not cfg.freeze_bn:
-                mutable = ["batch_stats"]
-        kwargs = dict(iters=cfg.iters, train=True, freeze_bn=cfg.freeze_bn,
-                      rngs={"dropout": rng}, mutable=mutable)
-        if cfg.fused_loss:
-            # Sequence loss fused into the scan: per-iteration scalars
-            # instead of stacked full-res flows (identical numerics at
-            # fp32; bf16-rounding-level difference when
-            # resolved_upsample_dtype is bfloat16).
-            kwargs["loss_targets"] = (batch["flow"], batch["valid"],
-                                      cfg.max_flow)
-        out = model.apply(variables, batch["image1"], batch["image2"],
-                          **kwargs)
-        out, new_vars = out if mutable else (out, {})
-        if cfg.fused_loss:
-            per_iter, metrics = out
-            i = jnp.arange(cfg.iters, dtype=per_iter.dtype)
-            weights = cfg.gamma ** (cfg.iters - i - 1.0)
-            loss = jnp.sum(weights * per_iter)
-        else:
-            loss, metrics = sequence_loss(
-                out, batch["flow"], batch["valid"],
-                gamma=cfg.gamma, max_flow=cfg.max_flow)
-        return loss, (metrics, new_vars.get("batch_stats"))
-
+    loss_fn = make_loss_fn(model, cfg)
     accum = max(int(getattr(cfg, "accum_steps", 1)), 1)
+    guard = bool(getattr(cfg, "nonfinite_guard", True))
 
     def step_fn(state: TrainState, batch: Dict, rng: jax.Array):
         rng = jax.random.fold_in(rng, state.step)
@@ -147,10 +171,29 @@ def make_train_step(model: RAFT, tx: optax.GradientTransformation,
                 lambda a, p: (a / accum).astype(p.dtype), acc,
                 state.params)
             loss = jnp.mean(losses)
-            metrics = jax.tree_util.tree_map(jnp.mean, metrics_seq)
-        new_state = state.apply_gradients(grads, tx, new_batch_stats=new_bs)
+            # Mean over the accum axis ONLY: scalar metrics stay scalars
+            # and the per-iteration curves (loss_iter/epe_iter) keep
+            # their (iters,) shape.
+            metrics = jax.tree_util.tree_map(
+                lambda x: jnp.mean(x, axis=0), metrics_seq)
+        new_state, norms = state.apply_gradients(
+            grads, tx, new_batch_stats=new_bs, return_norms=True)
         metrics = dict(metrics, loss=loss,
-                       grad_norm=optax.global_norm(grads))
+                       grad_norm=optax.global_norm(grads), **norms)
+        if guard:
+            ok = tree_all_finite((loss, grads))
+            cnt = state.nonfinite_steps
+            if cnt is None:  # legacy state without the counter
+                cnt = jnp.zeros((), jnp.int32)
+            # Gate the whole update: the skipped branch re-emits the
+            # input params/opt_state/batch_stats bit-identically (the
+            # step index still advances — the schedule and the data
+            # stream move on past the poisoned batch).
+            good = new_state.replace(nonfinite_steps=cnt)
+            bad = state.replace(step=state.step + 1,
+                                nonfinite_steps=cnt + 1)
+            new_state = tree_select(ok, good, bad)
+            metrics["nonfinite"] = 1.0 - ok.astype(jnp.float32)
         return new_state, metrics
 
     if mesh is None:

@@ -1,0 +1,202 @@
+"""Regression gate: fold the newest bench/telemetry JSON against the
+prior series and fail loudly.
+
+Inputs are the one-line JSON records the repo's measurement tools
+already produce — ``bench.py`` / ``scripts/bench_input.py`` /
+``scripts/bench_serve.py`` output, driver ``BENCH_*.json`` wrappers
+(the record under their ``parsed`` key), and
+``scripts/telemetry_summary.py`` output (whose ``config`` block now
+carries ``nonfinite_steps_total``).  Records are grouped by ``metric``
+in the order given (the default glob sorts ``BENCH_r01..rNN``), and the
+NEWEST record of each series is gated:
+
+- **throughput regression**: newest ``value`` more than
+  ``--max-drop-pct`` below the median of the last ``--window`` prior
+  non-null values of the same metric -> exit 1;
+- **numerics**: any ``config.nonfinite_steps_total > 0`` in a newest
+  record -> exit 1 (a run that needed the non-finite guard is not a
+  clean number);
+- optional ``--min-vs-baseline``: newest ``vs_baseline`` below the
+  floor -> exit 1 (BASELINE.json's 30 pairs/sec/chip north star is the
+  1.0 point of that field).
+
+Records with ``value: null`` (backend unavailable — the CPU container
+writing TPU series) are reported but never gate, so the check is safe
+in CI without hardware.
+
+::
+
+    python scripts/check_regression.py                  # BENCH_*.json
+    python scripts/check_regression.py runs/summary.json BENCH_r*.json
+    python scripts/check_regression.py --tiny           # CPU self-test
+
+``--tiny`` builds a synthetic series in a temp dir and asserts the gate
+passes a flat series, catches an injected 30% drop, and catches an
+injected non-finite count — the gate gating itself (wired into tier-1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import statistics
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="bench/telemetry JSON regression gate")
+    p.add_argument("paths", nargs="*",
+                   help="JSON records, oldest first (default: "
+                        "BENCH_*.json in the repo root, name-sorted)")
+    p.add_argument("--max-drop-pct", type=float, default=10.0,
+                   help="fail when the newest value drops more than "
+                        "this %% below the prior-series median")
+    p.add_argument("--window", type=int, default=3,
+                   help="prior records per metric forming the "
+                        "reference median")
+    p.add_argument("--min-vs-baseline", type=float, default=None,
+                   help="fail when the newest vs_baseline is below "
+                        "this floor (unset = no check)")
+    p.add_argument("--tiny", action="store_true",
+                   help="self-test on synthetic series (CPU smoke; "
+                        "exercises the pass, drop and nonfinite paths)")
+    return p.parse_args(argv)
+
+
+def load_record(path):
+    """One bench-format record from ``path`` (unwraps the driver's
+    ``parsed`` envelope); None when the file holds neither."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(d, dict) and isinstance(d.get("parsed"), dict):
+        d = d["parsed"]
+    if isinstance(d, dict) and "metric" in d:
+        return d
+    return None
+
+
+def build_series(paths):
+    """metric -> [records oldest..newest] (input order preserved)."""
+    series = {}
+    for path in paths:
+        rec = load_record(path)
+        if rec is not None:
+            series.setdefault(rec["metric"], []).append(
+                dict(rec, _path=path))
+    return series
+
+
+def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None):
+    """``(failures, report)`` over the newest record of each metric."""
+    failures, report = [], []
+    for metric, recs in sorted(series.items()):
+        newest = recs[-1]
+        value = newest.get("value")
+        cfg = newest.get("config") or {}
+        entry = {"metric": metric, "value": value,
+                 "path": newest.get("_path"), "n_records": len(recs)}
+        nf = cfg.get("nonfinite_steps_total")
+        if isinstance(nf, (int, float)) and nf > 0:
+            failures.append(
+                f"{metric}: nonfinite_steps_total={int(nf)} — the run "
+                "hit the non-finite guard; its numbers are not clean")
+        if value is None:
+            entry["skipped"] = "value null (backend unavailable)"
+            report.append(entry)
+            continue
+        prior = [r.get("value") for r in recs[:-1]
+                 if isinstance(r.get("value"), (int, float))]
+        if prior:
+            ref = statistics.median(prior[-max(window, 1):])
+            entry["reference"] = ref
+            if ref > 0:
+                drop = (ref - value) / ref * 100.0
+                entry["drop_pct"] = round(drop, 2)
+                if drop > max_drop_pct:
+                    failures.append(
+                        f"{metric}: {value} is {drop:.1f}% below the "
+                        f"prior-series median {ref} "
+                        f"(threshold {max_drop_pct}%)")
+        vs = newest.get("vs_baseline")
+        if (min_vs_baseline is not None
+                and isinstance(vs, (int, float)) and vs < min_vs_baseline):
+            failures.append(f"{metric}: vs_baseline {vs} < floor "
+                            f"{min_vs_baseline}")
+        report.append(entry)
+    return failures, report
+
+
+def _selftest() -> int:
+    """The gate gating itself: synthetic series through the real
+    file-loading path."""
+
+    def run(values, nonfinite_last=0, drop_pct=10.0):
+        with tempfile.TemporaryDirectory() as td:
+            paths = []
+            for i, v in enumerate(values):
+                rec = {"metric": "train_throughput_tiny", "value": v,
+                       "unit": "image-pairs/sec/chip", "vs_baseline": 0.0,
+                       "config": {}}
+                if i == len(values) - 1 and nonfinite_last:
+                    rec["config"]["nonfinite_steps_total"] = nonfinite_last
+                if i % 2:  # alternate raw and driver-wrapped envelopes
+                    rec = {"n": i, "rc": 0, "parsed": rec}
+                p = os.path.join(td, f"BENCH_r{i:02d}.json")
+                with open(p, "w") as f:
+                    json.dump(rec, f)
+                paths.append(p)
+            return check(build_series(paths), max_drop_pct=drop_pct)
+
+    cases = [
+        ("flat series passes", run([30.0, 31.0, 30.5]), False),
+        ("30% drop fails", run([30.0, 31.0, 21.0]), True),
+        ("nonfinite fails", run([30.0, 31.0, 30.5], nonfinite_last=2),
+         True),
+        ("null value never gates", run([30.0, 31.0, None]), False),
+        ("single record passes", run([30.0]), False),
+    ]
+    bad = [name for name, (failures, _), want_fail in cases
+           if bool(failures) != want_fail]
+    print(json.dumps({
+        "metric": "check_regression_selftest",
+        "value": 0.0 if bad else 1.0,
+        "unit": "pass",
+        "vs_baseline": 0.0,
+        "config": {"cases": len(cases), "failed": bad},
+    }))
+    return 1 if bad else 0
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.tiny:
+        return _selftest()
+    paths = args.paths or sorted(
+        glob.glob(os.path.join(REPO, "BENCH_*.json")))
+    if not paths:
+        raise SystemExit("no input records (no BENCH_*.json found and "
+                         "no paths given)")
+    failures, report = check(build_series(paths),
+                             max_drop_pct=args.max_drop_pct,
+                             window=args.window,
+                             min_vs_baseline=args.min_vs_baseline)
+    print(json.dumps({"ok": not failures, "failures": failures,
+                      "checked": report}))
+    if failures:
+        for f in failures:
+            print(f"REGRESSION: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

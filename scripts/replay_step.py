@@ -1,0 +1,159 @@
+"""Re-run a forensic bundle's exact training step offline.
+
+When the in-graph non-finite guard skips an update, the training loop
+writes a bundle (``telemetry_dir/forensics/stepNNNNNNNN.npz``: the
+post-noise host batch + step + RNG seed + per-step metrics + the model
+and train config dicts — ``raft_tpu/obs/health.py``).  This script
+replays that step — same batch, same ``fold_in(PRNGKey(seed), step)``
+dropout key, same loss path (``raft_tpu.train.step.make_loss_fn``, the
+function the train step differentiates) — against a checkpoint, and
+reports where the numerics blew up::
+
+    python scripts/replay_step.py --bundle runs/t/forensics/step00000042.npz \
+        --ckpt checkpoints/raft-chairs
+    # {"reproduced": true, "loss": Infinity, "nonfinite_grad_leaves":
+    #  {"fnet/conv1/kernel": 123, ...}, ...}
+
+``--ckpt`` should hold the run's checkpoint at (or before) the
+offending step — with the guard on, params at the flagged step are
+bit-identical to the last checkpoint plus the intervening *finite*
+updates, so the latest pre-blow-up checkpoint usually reproduces; a
+fresh init (``--random-init``) only answers "is the batch itself
+poisoned" (inf/NaN pixels, absurd flow magnitudes), which the script
+checks first either way.
+
+Exit status: 0 normally; with ``--expect-nonfinite`` (the e2e test /
+incident-runbook mode), non-zero when the replay comes out finite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="replay a non-finite-step forensic bundle")
+    p.add_argument("--bundle", required=True,
+                   help="forensics .npz written by the train loop")
+    p.add_argument("--ckpt", default=None,
+                   help="orbax checkpoint dir of the run "
+                        "(ckpt_dir/name); latest step is restored")
+    p.add_argument("--random-init", action="store_true",
+                   help="replay from a fresh init instead of a "
+                        "checkpoint (batch-poisoning check only)")
+    p.add_argument("--expect-nonfinite", action="store_true",
+                   help="exit non-zero if the replay does NOT "
+                        "reproduce a non-finite loss/grad")
+    return p.parse_args(argv)
+
+
+def _tuplify(cfg_dict, keys):
+    out = dict(cfg_dict)
+    for k in keys:
+        if k in out and isinstance(out[k], list):
+            out[k] = tuple(out[k])
+    return out
+
+
+def _leaf_name(path) -> str:
+    import jax
+
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def replay(bundle_path: str, ckpt: str = None, random_init: bool = False):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.obs.health import load_forensic_bundle
+    from raft_tpu.train.optim import make_optimizer
+    from raft_tpu.train.step import init_state, make_loss_fn
+
+    batch, meta = load_forensic_bundle(bundle_path)
+    if batch is None:
+        raise SystemExit(
+            f"{bundle_path}: bundle has no batch arrays (the host batch "
+            "was already evicted from the forensics ring when the flag "
+            "was observed) — lower log_freq or raise forensic_keep on "
+            "the next run to capture it")
+    step = int(meta["step"])
+    seed = int(meta.get("seed", 0))
+    model_cfg = RAFTConfig(**meta["model_cfg"])
+    cfg = TrainConfig(**_tuplify(meta["train_cfg"],
+                                 ("image_size", "validation")))
+
+    # Batch-level poisoning first: no model needed to spot an inf pixel.
+    batch_nonfinite = {
+        k: int(np.size(v) - np.isfinite(v).sum()) for k, v in batch.items()
+    }
+
+    model = RAFT(model_cfg)
+    tx = make_optimizer(cfg.lr, cfg.num_steps, cfg.wdecay, cfg.epsilon,
+                        cfg.clip)
+    template = init_state(model, tx, jax.random.PRNGKey(cfg.seed),
+                          (48, 64))
+    restored_step = None
+    if ckpt and not random_init:
+        from raft_tpu.train.checkpoint import CheckpointManager
+
+        state = CheckpointManager(ckpt).restore_latest(template)
+        if state is None:
+            raise SystemExit(f"no checkpoint under {ckpt!r}")
+        restored_step = int(state.step)
+    else:
+        state = template
+
+    # The train step's RNG: fold_in(PRNGKey(cfg.seed), step) — the loop
+    # passes PRNGKey(seed) and folds the state's step in-graph.
+    rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+    loss_fn = make_loss_fn(model, cfg)
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+    jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+    (loss, (metrics, _)), grads = grad_fn(state.params, state.batch_stats,
+                                          jbatch, rng)
+
+    loss = float(loss)
+    bad_leaves = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(grads):
+        n = int(leaf.size - jnp.isfinite(leaf).sum())
+        if n:
+            bad_leaves[_leaf_name(path)] = n
+    reproduced = (not np.isfinite(loss)) or bool(bad_leaves)
+    return {
+        "bundle": bundle_path,
+        "step": step,
+        "restored_step": restored_step,
+        "reproduced": reproduced,
+        "loss": loss,
+        "batch_nonfinite_elements": batch_nonfinite,
+        "nonfinite_grad_leaves": bad_leaves,
+        "metrics_at_capture": meta.get("metrics", {}),
+    }
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if not args.ckpt and not args.random_init:
+        raise SystemExit("pass --ckpt <dir> (or --random-init for the "
+                         "batch-poisoning check)")
+    report = replay(args.bundle, ckpt=args.ckpt,
+                    random_init=args.random_init)
+    print(json.dumps(report))
+    if args.expect_nonfinite and not report["reproduced"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

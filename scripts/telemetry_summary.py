@@ -66,16 +66,20 @@ def iter_records(path):
 
 
 def last_run(records):
-    """``(run_config, [train_step...])`` of the LAST run in the log
-    (files append across runs; run_config marks each start)."""
-    run_cfg, steps = None, []
+    """``(run_config, [train_step...], [train_health...])`` of the LAST
+    run in the log (files append across runs; run_config marks each
+    start).  Logs from builds without training-health telemetry simply
+    yield an empty health list."""
+    run_cfg, steps, health = None, [], []
     for rec in records:
         ev = rec.get("event")
         if ev == "run_config":
-            run_cfg, steps = rec, []
+            run_cfg, steps, health = rec, [], []
         elif ev == "train_step":
             steps.append(rec)
-    return run_cfg, steps
+        elif ev == "train_health":
+            health.append(rec)
+    return run_cfg, steps, health
 
 
 def _wait_s(rec):
@@ -87,7 +91,7 @@ def _wait_s(rec):
     return rec.get("queue_wait_s", rec.get("data_wait_s", 0.0))
 
 
-def summarize(run_cfg, steps, skip=2):
+def summarize(run_cfg, steps, health=None, skip=2):
     if run_cfg is None:
         raise SystemExit("no run_config event in log (telemetry written "
                          "by an older build?) — cannot recover batch "
@@ -106,6 +110,20 @@ def summarize(run_cfg, steps, skip=2):
     vs = (value / BASELINE_PAIRS_PER_SEC_PER_CHIP
           if _stage_name(h, w) == "flyingchairs" else 0.0)
     times = sorted(r["step_time_s"] for r in kept)
+    # Training-health fields from the run's last train_health record
+    # (docs/OBSERVABILITY.md): non-finite step count gates
+    # scripts/check_regression.py; the final update-ratio and per-
+    # iteration EPE curve summarize where the run's numerics ended up.
+    # Old logs without the event just omit the fields.
+    health_cfg = {}
+    last_health = (health or [None])[-1]
+    if last_health is not None:
+        health_cfg["nonfinite_steps_total"] = last_health.get(
+            "nonfinite_steps_total", 0)
+        if "update_ratio" in last_health:
+            health_cfg["final_update_ratio"] = last_health["update_ratio"]
+        if "epe_iter" in last_health:
+            health_cfg["final_epe_iter"] = last_health["epe_iter"]
     return {
         "metric": _train_metric_name(h, w),
         "value": round(value, 3),
@@ -125,14 +143,15 @@ def summarize(run_cfg, steps, skip=2):
             "queue_wait_frac": round(wait / wall, 4) if wall > 0 else 0.0,
             "h2d_frac": round(h2d / wall, 4) if wall > 0 else 0.0,
             "step_time_p50_s": round(times[len(times) // 2], 6),
+            **health_cfg,
         },
     }
 
 
 def main(argv=None):
     args = parse_args(argv)
-    run_cfg, steps = last_run(iter_records(args.path))
-    print(json.dumps(summarize(run_cfg, steps, skip=args.skip)))
+    run_cfg, steps, health = last_run(iter_records(args.path))
+    print(json.dumps(summarize(run_cfg, steps, health, skip=args.skip)))
 
 
 if __name__ == "__main__":

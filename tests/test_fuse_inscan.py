@@ -37,9 +37,10 @@ def test_fused_inscan_matches_two_scan():
     (o1, mets1), _ = m1.apply(v, img1, img2, **kwargs)
     np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
                                rtol=1e-5, atol=1e-7)
-    for kk in mets2:
-        np.testing.assert_allclose(float(mets1[kk]), float(mets2[kk]),
-                                   rtol=1e-5)
+    for kk in mets2:  # scalars AND the per-iteration epe_iter curve
+        np.testing.assert_allclose(np.asarray(mets1[kk]),
+                                   np.asarray(mets2[kk]), rtol=1e-5,
+                                   err_msg=kk)
 
     def loss_fn(model):
         def f(params):

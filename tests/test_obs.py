@@ -253,9 +253,18 @@ class _SyncSpy:
         return np.asarray(self._v, dtype or np.float32)
 
 
+# The numerics-telemetry metric surface the real step emits
+# (train/step.py): every key a transfer-counting spy, so the no-sync
+# contract below covers the training-health path too — the health
+# monitor must feed off the Logger's converted arrays, never pull its
+# own.
+_STUB_METRIC_KEYS = ("loss", "param_norm", "update_ratio", "nonfinite",
+                     "epe_iter")
+
+
 def _stub_loop(monkeypatch, loop_mod):
     """Stub init_state/make_train_step on the loop module: a 'step' just
-    bumps the counter and returns a transfer-counting loss."""
+    bumps the counter and returns transfer-counting metrics."""
     from raft_tpu.train.state import TrainState
 
     def fake_init_state(model, tx, rng, size):
@@ -265,8 +274,11 @@ def _stub_loop(monkeypatch, loop_mod):
 
     def fake_make_train_step(model, tx, cfg, mesh, shard_spatial=False):
         def step_fn(state, batch, key):
-            return (state.replace(step=state.step + 1),
-                    {"loss": _SyncSpy(1.0)})
+            metrics = {k: _SyncSpy([0.5, 0.25] if k == "epe_iter"
+                                   else 1.0)
+                       for k in _STUB_METRIC_KEYS}
+            metrics["nonfinite"] = _SyncSpy(0.0)
+            return state.replace(step=state.step + 1), metrics
 
         return step_fn
 
@@ -324,10 +336,14 @@ def test_loop_data_wait_and_no_per_step_sync(tmp_path, monkeypatch,
 
     transfers_off, flushes_off = run("off", None)
     transfers_on, flushes_on = run("on", str(tdir))
-    # Telemetry adds ZERO host transfers, and the Logger still flushes
-    # once per log_freq interval (4 steps / 2 = 2 flushes), pulling one
-    # value per buffered step record — never per step as it happens.
-    assert transfers_on == transfers_off == 4  # num_steps * one key
+    # Telemetry — including the training-health path (HealthMonitor +
+    # registry gauges + train_health events, active on the "on" run) —
+    # adds ZERO host transfers, and the Logger still flushes once per
+    # log_freq interval (4 steps / 2 = 2 flushes), pulling each
+    # buffered step value exactly once — never per step as it happens,
+    # and never a second time for the health observer.
+    expected = 4 * len(_STUB_METRIC_KEYS)  # num_steps * metric keys
+    assert transfers_on == transfers_off == expected
     assert flushes_on == flushes_off == 2
 
     (f,) = tdir.glob("telemetry-p*.jsonl")

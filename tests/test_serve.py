@@ -94,6 +94,55 @@ def test_mixed_load_matches_eval_and_compiles_once(engine, variables):
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
 
 
+def test_healthz_readiness_reports_device_stall(variables):
+    """GET /v1/healthz is readiness, not liveness: with a request
+    pending and no device batch completed within ``stall_timeout_s``
+    the route turns 503 with the stall detail, and recovers to 200
+    ``ok`` once the device worker completes the batch."""
+    import time
+
+    from raft_tpu.cli.serve import make_server
+
+    # A long max_wait holds the first request pending (the batch waits
+    # to fill), modelling a device worker not completing batches; the
+    # tiny stall threshold trips inside that window.
+    eng = InferenceEngine(variables, CFG, ServeConfig(
+        iters=ITERS, max_batch=4, batch_sizes=(4,), max_wait_ms=2500,
+        max_queue=8, stall_timeout_s=0.2))
+    eng.start()
+    server = make_server(eng, "127.0.0.1", 0)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://{host}:{port}"
+    try:
+        # idle engine: no pending work -> ready even with no batch ever
+        with urllib.request.urlopen(base + "/v1/healthz",
+                                    timeout=30) as r:
+            assert r.status == 200 and r.read() == b"ok"
+
+        rng = np.random.default_rng(4)
+        im1, im2 = _images(rng, 36, 52)
+        fut = eng.submit(im1, im2)
+        time.sleep(0.6)  # pending > 0, no batch done, past threshold
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/v1/healthz", timeout=30)
+        assert ei.value.code == 503
+        detail = json.loads(ei.value.read())
+        assert detail["ready"] is False and detail["stalled"] is True
+        assert detail["pending"] == 1
+
+        assert fut.result(timeout=120).shape == (36, 52, 2)
+        h = eng.health()
+        assert h["ready"] and h["seconds_since_last_batch"] is not None
+        with urllib.request.urlopen(base + "/v1/healthz",
+                                    timeout=30) as r:
+            assert r.status == 200 and r.read() == b"ok"
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.stop()
+
+
 def test_backpressure_rejects_past_max_queue(variables):
     """With the dispatcher holding batches open (long max_wait_ms), the
     ``max_queue``+1-th submit is rejected immediately — the queue is

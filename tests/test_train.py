@@ -205,6 +205,11 @@ def test_fused_loss_matches_stacked():
     for k in ("loss", "epe", "1px", "3px", "5px", "grad_norm"):
         np.testing.assert_allclose(float(m_f[k]), float(m_s[k]),
                                    rtol=1e-5, err_msg=k)
+    # the per-iteration curves (refinement-convergence telemetry) must
+    # agree between the fused and stacked paths too
+    for k in ("loss_iter", "epe_iter"):
+        np.testing.assert_allclose(np.asarray(m_f[k]), np.asarray(m_s[k]),
+                                   rtol=1e-5, err_msg=k)
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
@@ -251,6 +256,9 @@ def test_fused_loss_matches_stacked_full_model():
 
     for k in ("loss", "epe", "1px", "3px", "5px", "grad_norm"):
         np.testing.assert_allclose(float(m_f[k]), float(m_s[k]),
+                                   rtol=1e-4, err_msg=k)
+    for k in ("loss_iter", "epe_iter"):
+        np.testing.assert_allclose(np.asarray(m_f[k]), np.asarray(m_s[k]),
                                    rtol=1e-4, err_msg=k)
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(
