@@ -21,6 +21,8 @@ _SUBCOMMANDS = {
     "evaluate": ("raft_tpu.cli.evaluate", "validation / leaderboard eval"),
     "demo": ("raft_tpu.cli.demo", "flow visualization over a frame dir"),
     "serve": ("raft_tpu.cli.serve", "online HTTP inference server"),
+    "verify-ckpt": ("raft_tpu.cli.verify_ckpt",
+                    "checkpoint integrity check / resume preview"),
     "lk-compare": ("raft_tpu.cli.lk_compare",
                    "RAFT vs Lucas-Kanade side-by-side"),
 }

@@ -1,0 +1,58 @@
+"""Deterministic fault injection + fault classification
+(docs/ROBUSTNESS.md).
+
+``RAFT_CHAOS_SPEC='corrupt_image@step=7;torn_ckpt@step=50'`` installs a
+seeded :class:`FaultPlan`; named injection points at the stack's hot
+seams (sample read, pipeline producer, checkpoint save/restore, serve
+device call) then fire those faults deterministically, so the
+self-healing paths — data quarantine, checkpoint fallback, serve retry
+— can be *exercised on purpose* instead of waited for.  Disabled (no
+plan installed) every point is a single module-global ``None`` check.
+
+Import-light by design: no jax at import time, safe inside data-loader
+workers.
+"""
+
+from raft_tpu.chaos.errors import (
+    InjectedCheckpointCorruption,
+    InjectedDeviceError,
+    InjectedProducerCrash,
+    InjectedWorkerCrash,
+    TRANSIENT_MARKERS,
+    is_transient_error,
+    tear_files,
+)
+from raft_tpu.chaos.plan import (
+    ChaosSpecError,
+    ENV_SEED,
+    ENV_SPEC,
+    FaultPlan,
+    Rule,
+    active,
+    enabled,
+    install,
+    install_from_env,
+    should_inject,
+    uninstall,
+)
+
+__all__ = [
+    "ChaosSpecError",
+    "ENV_SEED",
+    "ENV_SPEC",
+    "FaultPlan",
+    "InjectedCheckpointCorruption",
+    "InjectedDeviceError",
+    "InjectedProducerCrash",
+    "InjectedWorkerCrash",
+    "Rule",
+    "TRANSIENT_MARKERS",
+    "active",
+    "enabled",
+    "install",
+    "install_from_env",
+    "is_transient_error",
+    "should_inject",
+    "tear_files",
+    "uninstall",
+]

@@ -1,0 +1,94 @@
+"""Injected-fault error types + transient-error classification.
+
+The injected classes deliberately subclass what the REAL failure would
+raise (a corrupt image read raises ``ValueError`` out of
+``frame_utils``; a flaky device dispatch raises a runtime error out of
+jaxlib), so the hardened paths cannot special-case chaos — they must
+handle the injection exactly like the genuine fault it models.
+
+:func:`is_transient_error` is the serve engine's retry policy
+(docs/ROBUSTNESS.md): jax/XLA *runtime* errors whose status suggests a
+transient dispatch failure are worth one retry; everything else —
+shape/dtype errors, compile failures, plain Python bugs — fails fast,
+because retrying a deterministic error only doubles its latency.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class InjectedWorkerCrash(RuntimeError):
+    """Chaos ``worker_err``: a loader-worker crash that is NOT a sample
+    decode error — must propagate and kill the run (fail-fast contract,
+    as a real bug in the loader would)."""
+
+
+class InjectedProducerCrash(RuntimeError):
+    """Chaos ``producer_err``: the DevicePipeline producer thread dies
+    mid-stream — must re-raise in the consumer's ``next()``."""
+
+
+class InjectedCheckpointCorruption(RuntimeError):
+    """Chaos ``restore_err``: a checkpoint step that fails to restore
+    (models a torn write without touching files)."""
+
+
+class InjectedDeviceError(RuntimeError):
+    """Chaos ``device_err``: a transient device dispatch failure —
+    explicitly marked retryable."""
+
+    transient = True
+
+
+#: Substrings of jax/XLA runtime-error messages that indicate a
+#: transient condition (mirrors the gRPC/absl status names TPU runtime
+#: errors carry).  DEADLINE_EXCEEDED/UNAVAILABLE/ABORTED are queue and
+#: transport flakes; INTERNAL shows up for one-off DMA/program-launch
+#: hiccups that a re-dispatch survives.
+TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "CANCELLED",
+    "INTERNAL",
+    "UNKNOWN",
+    "connection reset",
+    "socket closed",
+    "transient",
+)
+
+#: Exception type names classified by message (jaxlib's XlaRuntimeError
+#: moves between modules across versions — match the name, not the
+#: import path).
+_RUNTIME_ERROR_TYPES = ("XlaRuntimeError", "JaxRuntimeError",
+                       "RpcError", "InternalError")
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """True when ``exc`` looks like a transient device/transport error
+    worth exactly one retry; False for anything deterministic."""
+    flagged = getattr(exc, "transient", None)
+    if flagged is not None:
+        return bool(flagged)
+    if type(exc).__name__ not in _RUNTIME_ERROR_TYPES:
+        return False
+    msg = str(exc)
+    return any(marker in msg for marker in TRANSIENT_MARKERS)
+
+
+def tear_files(directory: str, keep_frac: float = 0.5) -> list:
+    """Truncate every regular file under ``directory`` to
+    ``keep_frac`` of its size — the torn-write simulator behind the
+    ``torn_ckpt`` fault (a preempted host mid-``fsync`` leaves exactly
+    this: the directory structure intact, the contents cut short).
+    Returns the torn paths."""
+    torn = []
+    for root, _dirs, files in os.walk(directory):
+        for name in files:
+            path = os.path.join(root, name)
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(int(size * keep_frac))
+            torn.append(path)
+    return sorted(torn)

@@ -90,6 +90,20 @@ def parse_args(argv=None):
                    help="write JSONL telemetry events (per-batch "
                         "records) into this directory; defaults to "
                         "$RAFT_TELEMETRY_DIR, unset = disabled")
+    p.add_argument("--device-retries", type=int, default=1,
+                   help="re-dispatches of a device batch after a "
+                        "TRANSIENT error (flaky XLA/runtime dispatch) "
+                        "before the batch fails; deterministic errors "
+                        "always fail fast (docs/ROBUSTNESS.md)")
+    p.add_argument("--retry-backoff-s", type=float, default=0.05,
+                   help="sleep before retry k is k * this")
+    p.add_argument("--chaos", default=None,
+                   help="fault-injection spec, e.g. 'device_err@batch=3'"
+                        " (docs/ROBUSTNESS.md grammar); default "
+                        "$RAFT_CHAOS_SPEC, unset = no injection")
+    p.add_argument("--chaos-seed", type=int, default=None,
+                   help="seed for probabilistic chaos rules "
+                        "(default $RAFT_CHAOS_SEED or 0)")
     return p.parse_args(argv)
 
 
@@ -184,6 +198,21 @@ def main(argv=None):
     if (args.model is None) == (not args.random_init):
         raise SystemExit("exactly one of --model / --random-init required")
 
+    import os
+
+    # Export before anything builds a default sink, so emitters without
+    # an explicit sink (chaos fires) land next to the engine's events.
+    if args.telemetry_dir:
+        os.environ.setdefault("RAFT_TELEMETRY_DIR", args.telemetry_dir)
+
+    from raft_tpu import chaos
+
+    if args.chaos:
+        os.environ[chaos.ENV_SPEC] = args.chaos
+    if args.chaos_seed is not None:
+        os.environ[chaos.ENV_SEED] = str(args.chaos_seed)
+    chaos.install_from_env()
+
     import jax
 
     from raft_tpu.config import RAFTConfig
@@ -212,7 +241,9 @@ def main(argv=None):
         buckets=_parse_hw_list(args.buckets) if args.buckets else None,
         batch_sizes=tuple(int(b) for b in args.batch_sizes.split(","))
         if args.batch_sizes else None,
-        stall_timeout_s=max(args.stall_timeout_s, 0.0))
+        stall_timeout_s=max(args.stall_timeout_s, 0.0),
+        device_retries=max(args.device_retries, 0),
+        retry_backoff_s=max(args.retry_backoff_s, 0.0))
     sink = None
     if args.telemetry_dir:
         from raft_tpu.obs import EventSink

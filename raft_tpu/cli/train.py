@@ -175,6 +175,15 @@ def parse_args(argv=None):
                    help="multi-host pod run: call "
                         "jax.distributed.initialize() (auto-detects the "
                         "coordinator on TPU pods) before touching devices")
+    p.add_argument("--chaos", default=None,
+                   help="deterministic fault-injection spec, e.g. "
+                        "'corrupt_image@step=7;torn_ckpt@step=50' "
+                        "(docs/ROBUSTNESS.md grammar) — exercises the "
+                        "quarantine/fallback paths on purpose; default "
+                        "$RAFT_CHAOS_SPEC, unset = no injection")
+    p.add_argument("--chaos-seed", type=int, default=None,
+                   help="seed for probabilistic chaos rules "
+                        "(default $RAFT_CHAOS_SEED or 0)")
     return p.parse_args(argv)
 
 
@@ -205,6 +214,20 @@ def resolve_batch(batch_size, batch_per_chip, num_devices, lr):
 
 def main(argv=None):
     args = parse_args(argv)
+
+    # Export the telemetry dir before anything builds a default sink, so
+    # event emitters without an explicit sink (chaos fires, library
+    # spans) land in the same directory as the per-step stream.
+    if args.telemetry_dir:
+        os.environ.setdefault("RAFT_TELEMETRY_DIR", args.telemetry_dir)
+
+    from raft_tpu import chaos
+
+    if args.chaos:
+        os.environ[chaos.ENV_SPEC] = args.chaos
+    if args.chaos_seed is not None:
+        os.environ[chaos.ENV_SEED] = str(args.chaos_seed)
+    chaos.install_from_env()
 
     import jax
 

@@ -1,0 +1,79 @@
+"""Checkpoint integrity verifier (docs/ROBUSTNESS.md).
+
+Runs the same check ``restore_latest``'s fallback chain applies at
+resume time — an actual restore of every saved step — but offline, so
+an operator can answer "will this run resume, and from which step?"
+before burning a pod slot on the attempt::
+
+    python -m raft_tpu verify-ckpt checkpoints/raft-chairs
+    python -m raft_tpu verify-ckpt checkpoints/raft-chairs --json
+
+Verification uses the raw metadata-driven restore (no model code, no
+template), so it works on any orbax run directory this repo wrote.
+
+Exit codes:
+
+- ``0`` — every saved step restores.
+- ``1`` — the newest step is torn but an older one is valid: resume
+  WILL work, falling back (the printed ``latest_valid`` step).
+- ``2`` — no saved step restores (or the directory is empty): resume
+  will raise ``CheckpointRestoreError``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="raft-tpu verify-ckpt",
+        description="verify every saved step of an orbax run directory "
+                    "restores; preview what auto-resume would do")
+    p.add_argument("ckpt_dir",
+                   help="orbax run directory (the ckpt_dir/name the "
+                        "train CLI writes)")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable JSON report line instead "
+                        "of per-step text")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    from raft_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(args.ckpt_dir, async_save=False)
+    try:
+        reports = mgr.verify_all()
+    finally:
+        mgr.close()
+    valid = [r["step"] for r in reports if r["ok"]]
+    latest_valid = max(valid) if valid else None
+    report = {
+        "dir": args.ckpt_dir,
+        "steps": reports,
+        "latest_valid": latest_valid,
+        "ok": bool(reports) and all(r["ok"] for r in reports),
+    }
+    if args.json:
+        print(json.dumps(report))
+    else:
+        if not reports:
+            print(f"{args.ckpt_dir}: no saved steps")
+        for r in reports:
+            status = "ok" if r["ok"] else f"CORRUPT ({r['error']})"
+            print(f"step {r['step']}: {status}")
+        if latest_valid is not None:
+            print(f"resume would restore step {latest_valid}")
+        else:
+            print("resume would FAIL: no restorable checkpoint")
+    if report["ok"]:
+        return 0
+    return 1 if latest_valid is not None else 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

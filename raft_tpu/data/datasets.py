@@ -29,14 +29,51 @@ from __future__ import annotations
 import dataclasses
 import os
 import os.path as osp
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from glob import glob
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from raft_tpu import chaos
 from raft_tpu.data import frame_utils
 from raft_tpu.data.augment import FlowAugmentor, SparseFlowAugmentor
+
+
+class SampleReadError(ValueError):
+    """A per-sample read/decode failure with its provenance attached.
+
+    The bare reader errors (``ValueError: truncated .flo``) name the
+    symptom but not which of 20k files is bad; every reader call in
+    :meth:`FlowDataset.load` is wrapped so the exception (and the
+    quarantine event built from it, docs/ROBUSTNESS.md) carries the
+    dataset name, split, sample index, and file path.  Subclasses
+    ``ValueError`` so existing handlers of decode errors keep working.
+    """
+
+    def __init__(self, path: str, dataset=None, index=None,
+                 detail: str = ""):
+        self.path = path
+        self.dataset_name = getattr(dataset, "name", None) \
+            or type(dataset).__name__
+        self.split = getattr(dataset, "split", None)
+        self.index = index
+        super().__init__(
+            f"{path}: {detail} [dataset={self.dataset_name} "
+            f"split={self.split or '-'} sample={index}]")
+
+
+def _read_sample(ds, index: int, path: str, reader):
+    """Run one reader call with sample context attached to decode/IO
+    failures (real corruption raises ValueError/OSError out of
+    frame_utils; anything else is a bug and propagates untouched)."""
+    try:
+        return reader(path)
+    except SampleReadError:
+        raise
+    except (ValueError, OSError) as e:
+        raise SampleReadError(path, ds, index, str(e)) from e
 
 
 def _to_rgb(img: np.ndarray) -> np.ndarray:
@@ -60,6 +97,10 @@ class FlowDataset:
         self.sparse = sparse
         self.is_test = False
         self.augmentor = None
+        # Sample-error provenance (SampleReadError / quarantine events):
+        # concrete datasets overwrite split after super().__init__.
+        self.name = type(self).__name__
+        self.split: Optional[str] = None
         if aug_params is not None:
             cls = SparseFlowAugmentor if sparse else FlowAugmentor
             self.augmentor = cls(**aug_params)
@@ -73,6 +114,8 @@ class FlowDataset:
         out.sparse = self.sparse
         out.is_test = self.is_test
         out.augmentor = self.augmentor
+        out.name = self.name
+        out.split = self.split
         out.flow_list = list(self.flow_list)
         out.image_list = list(self.image_list)
         out.extra_info = list(self.extra_info)
@@ -109,8 +152,13 @@ class FlowDataset:
         """
         ds, index = self._sample_parts(index)
         index = index % len(ds.image_list)
-        img1 = _to_rgb(frame_utils.read_gen(ds.image_list[index][0]))
-        img2 = _to_rgb(frame_utils.read_gen(ds.image_list[index][1]))
+        if chaos.should_inject("corrupt_image", point="data.sample_read"):
+            raise SampleReadError(ds.image_list[index][0], ds, index,
+                                  "chaos-injected corrupt sample")
+        img1 = _read_sample(ds, index, ds.image_list[index][0],
+                            lambda p: _to_rgb(frame_utils.read_gen(p)))
+        img2 = _read_sample(ds, index, ds.image_list[index][1],
+                            lambda p: _to_rgb(frame_utils.read_gen(p)))
 
         if ds.is_test:
             return {"image1": img1.astype(np.float32),
@@ -119,10 +167,12 @@ class FlowDataset:
 
         valid = None
         if ds.sparse:
-            flow, valid = frame_utils.read_flow_kitti(ds.flow_list[index])
+            flow, valid = _read_sample(ds, index, ds.flow_list[index],
+                                       frame_utils.read_flow_kitti)
         else:
-            flow = np.asarray(frame_utils.read_gen(ds.flow_list[index]),
-                              np.float32)
+            flow = _read_sample(
+                ds, index, ds.flow_list[index],
+                lambda p: np.asarray(frame_utils.read_gen(p), np.float32))
 
         if ds.augmentor is not None:
             if rng is None:
@@ -152,6 +202,8 @@ class ConcatFlowDataset(FlowDataset):
             flat.extend(p.parts if isinstance(p, ConcatFlowDataset) else [p])
         self.parts = flat
         self.is_test = False
+        self.name = "Concat(" + "+".join(p.name for p in flat) + ")"
+        self.split = None  # per-sample context comes from the member
         self._offsets = np.cumsum([len(p) for p in flat])
 
     def __len__(self) -> int:
@@ -179,6 +231,7 @@ class MpiSintel(FlowDataset):
     def __init__(self, aug_params=None, split="training",
                  root="datasets/Sintel", dstype="clean"):
         super().__init__(aug_params)
+        self.split = split
         flow_root = osp.join(root, split, "flow")
         image_root = osp.join(root, split, dstype)
         if split == "test":
@@ -201,6 +254,7 @@ class FlyingChairs(FlowDataset):
                  root="datasets/FlyingChairs_release/data",
                  split_file="chairs_split.txt"):
         super().__init__(aug_params)
+        self.split = split
         images = sorted(glob(osp.join(root, "*.ppm")))
         flows = sorted(glob(osp.join(root, "*.flo")))
         assert len(images) // 2 == len(flows), (len(images), len(flows))
@@ -224,6 +278,7 @@ class FlyingThings3D(FlowDataset):
     def __init__(self, aug_params=None, root="datasets/FlyingThings3D",
                  dstype="frames_cleanpass"):
         super().__init__(aug_params)
+        self.split = dstype
         for cam in ["left"]:
             for direction in ["into_future", "into_past"]:
                 image_dirs = sorted(glob(osp.join(root, dstype, "TRAIN/*/*")))
@@ -250,6 +305,7 @@ class KITTI(FlowDataset):
     def __init__(self, aug_params=None, split="training",
                  root="datasets/KITTI"):
         super().__init__(aug_params, sparse=True)
+        self.split = split
         if split == "testing":
             self.is_test = True
         root = osp.join(root, split)
@@ -355,11 +411,32 @@ class ShardedLoader:
     # filesystems) so a slow sample doesn't drain the window; it bounds
     # decoded-sample host RAM at ~prefetch_batches*batch_size samples.
     prefetch_batches: int = 0
+    # Self-healing sample reads (docs/ROBUSTNESS.md): a decode/IO error
+    # (ValueError/OSError — a corrupt image, a truncated .flo) is
+    # retried ``sample_retries`` times against the SAME file (transient
+    # filesystem flakes), then the sample is QUARANTINED — skipped with
+    # a `sample_quarantine` JSONL event + `raft_data_quarantined_total`
+    # counter — and a deterministic replacement index (keyed on
+    # seed/epoch/index, NOT on wall clock or scheduling) is drawn so
+    # batch shape and the rest of the stream are unchanged.  Up to
+    # ``sample_resamples`` replacements are tried before the loader
+    # gives up: one bad file costs one event, a rotten dataset still
+    # fails loudly.  Non-decode errors (a loader bug) propagate.
+    sample_retries: int = 1
+    sample_resamples: int = 8
+    # Telemetry destinations for quarantine; None = the process-wide
+    # defaults (train() points these at its own sink/registry).
+    sink: Optional[object] = None
+    registry: Optional[object] = None
 
     def __post_init__(self):
         assert 0 <= self.host_id < self.num_hosts
         assert len(self.dataset) > 0, "empty dataset"
         assert self.prefetch_batches >= 0, self.prefetch_batches
+        assert self.sample_retries >= 0, self.sample_retries
+        assert self.sample_resamples >= 0, self.sample_resamples
+        self.quarantined_total = 0
+        self._quarantine_lock = threading.Lock()
 
     def epoch_indices(self, epoch: int) -> np.ndarray:
         """The host's sample indices for ``epoch`` — a disjoint stride of a
@@ -369,10 +446,71 @@ class ShardedLoader:
         perm = rng.permutation(len(self.dataset))
         return perm[self.host_id::self.num_hosts]
 
+    #: Seed-stream salt separating replacement-index draws from the
+    #: per-sample augmentation streams (arbitrary constant).
+    _RESAMPLE_SALT = 0x51A7
+
     def _load_one(self, epoch: int, index: int) -> Dict[str, np.ndarray]:
-        rng = np.random.default_rng(
-            np.random.SeedSequence([self.seed, epoch, int(index)]))
-        return self.dataset.load(int(index), rng)
+        """Load one sample, self-healing decode failures.
+
+        Healthy path: identical to the pre-chaos loader (same RNG
+        derivation, one ``dataset.load``).  On ValueError/OSError the
+        sample is retried then quarantined and a deterministic
+        replacement drawn — see the ``sample_retries`` field comment.
+        """
+        if chaos.should_inject("worker_err", point="data.loader_worker"):
+            from raft_tpu.chaos import InjectedWorkerCrash
+
+            raise InjectedWorkerCrash(
+                "chaos-injected loader-worker crash (not a decode "
+                "error: must fail the run, not quarantine)")
+        index = int(index)
+        idx, last_err = index, None
+        for resample in range(self.sample_resamples + 1):
+            for _attempt in range(self.sample_retries + 1):
+                # Fresh generator per attempt: a failed load may have
+                # consumed part of the stream, and the replacement
+                # sample must see exactly the draw it would get were it
+                # drawn first-class (stream determinism).
+                rng = np.random.default_rng(
+                    np.random.SeedSequence([self.seed, epoch, idx]))
+                try:
+                    return self.dataset.load(idx, rng)
+                except (ValueError, OSError) as e:
+                    last_err = e
+            self._quarantine(epoch, index, idx, resample, last_err)
+            r = np.random.default_rng(np.random.SeedSequence(
+                [self.seed, epoch, index, self._RESAMPLE_SALT, resample]))
+            idx = int(r.integers(len(self.dataset)))
+        raise RuntimeError(
+            f"sample {index} and {self.sample_resamples} replacement "
+            f"draw(s) all failed to load — giving up (last error: "
+            f"{type(last_err).__name__}: {last_err})") from last_err
+
+    def _quarantine(self, epoch: int, index: int, idx: int,
+                    resample: int, err: Exception) -> None:
+        from raft_tpu.obs.events import default_sink
+        from raft_tpu.obs.registry import default_registry
+
+        with self._quarantine_lock:
+            self.quarantined_total += 1
+        reg = self.registry if self.registry is not None \
+            else default_registry()
+        reg.counter(
+            "raft_data_quarantined_total",
+            "samples skipped after repeated read failures "
+            "(replaced by a deterministic resample)").inc()
+        sink = self.sink if self.sink is not None else default_sink()
+        sink.emit("sample_quarantine",
+                  dataset=getattr(err, "dataset_name", None)
+                  or getattr(self.dataset, "name",
+                             type(self.dataset).__name__),
+                  split=getattr(err, "split", None),
+                  path=getattr(err, "path", None),
+                  epoch=int(epoch), index=int(idx),
+                  original_index=int(index), resample=int(resample),
+                  retries=int(self.sample_retries),
+                  error=f"{type(err).__name__}: {err}")
 
     def steps_per_epoch(self) -> int:
         """Per-host batches per epoch (constant across epochs: the global

@@ -44,8 +44,23 @@ import threading
 import time
 from typing import Callable, Iterable, Iterator, Optional
 
+from raft_tpu import chaos
+from raft_tpu.chaos import InjectedProducerCrash
+
 # Producer -> consumer message kinds.
 _ITEM, _END, _ERROR = "item", "end", "error"
+
+
+def _chaos_producer_point(ordinal: int) -> None:
+    """`pipeline.producer` injection seam (docs/ROBUSTNESS.md): fires
+    the ``producer_err`` fault before batch ``ordinal`` is pulled — on
+    the producer thread when buffered, inline at depth 0 — exercising
+    the error-propagation contract (the consumer's ``next()`` re-raises,
+    ``close()`` joins).  One no-op module check when chaos is off."""
+    if chaos.should_inject("producer_err", step=ordinal,
+                           point="pipeline.producer"):
+        raise InjectedProducerCrash(
+            f"chaos-injected producer crash before batch {ordinal}")
 
 
 class DevicePipeline:
@@ -110,6 +125,7 @@ class DevicePipeline:
 
     # -- producer (depth > 0) -------------------------------------------
     def _produce(self) -> None:
+        produced = 0  # pull ordinal, matches the serial path's count
         try:
             while True:
                 # Slot first: never pull (or decode, or device_put) a
@@ -119,6 +135,8 @@ class DevicePipeline:
                         return
                 if self._stop.is_set():
                     return
+                _chaos_producer_point(produced)
+                produced += 1
                 try:
                     batch = next(self._src)
                 except StopIteration:
@@ -152,6 +170,7 @@ class DevicePipeline:
         if self.depth == 0:
             # The exact old serial path: prep + put inline, on this
             # thread, one batch at a time.
+            _chaos_producer_point(self.batches_out)
             batch = next(self._src)  # StopIteration propagates
             t0 = time.perf_counter()
             if self._prep is not None:

@@ -58,6 +58,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import chaos
+from raft_tpu.chaos import InjectedDeviceError, is_transient_error
 from raft_tpu.config import RAFTConfig
 from raft_tpu.obs import EventSink, MetricRegistry
 from raft_tpu.ops.pad import InputPadder, bucket_hw
@@ -88,7 +90,13 @@ class ServeConfig:
     and no device batch completed for this long, ``health()`` reports
     not-ready (``GET /v1/healthz`` -> 503) so a balancer drains a
     wedged replica; must exceed ``max_wait_ms`` + the worst cold
-    compile (or warm up first); 0 disables the check."""
+    compile (or warm up first); 0 disables the check.
+    ``device_retries``: device-call re-dispatches for errors classified
+    transient (:func:`raft_tpu.chaos.is_transient_error`) before the
+    whole batch fails — one flaky dispatch no longer 500s every
+    co-batched request; deterministic errors always fail fast
+    (docs/ROBUSTNESS.md).  ``retry_backoff_s`` is the sleep before
+    attempt k (linear: ``k * retry_backoff_s``)."""
 
     iters: int = 32
     max_batch: int = 8
@@ -100,6 +108,8 @@ class ServeConfig:
     pad_mode: str = "sintel"
     latency_window: int = 4096
     stall_timeout_s: float = 120.0
+    device_retries: int = 1
+    retry_backoff_s: float = 0.05
 
     def __post_init__(self):
         if self.max_batch < 1 or self.max_queue < 1:
@@ -108,6 +118,9 @@ class ServeConfig:
             raise ValueError("max_wait_ms must be >= 0")
         if self.stall_timeout_s < 0:
             raise ValueError("stall_timeout_s must be >= 0")
+        if self.device_retries < 0 or self.retry_backoff_s < 0:
+            raise ValueError(
+                "device_retries and retry_backoff_s must be >= 0")
         m = self.bucket_multiple
         for hw in self.buckets or ():
             if hw[0] % m or hw[1] % m:
@@ -202,6 +215,10 @@ class InferenceEngine:
             "raft_serve_seconds_since_last_batch",
             "seconds since the last completed device batch (refreshed "
             "at scrape; absent before the first batch)")
+
+        # Device-batch ordinal (1-based; device-worker thread only) —
+        # the `device_err@batch=N` chaos trigger context.
+        self._batch_seq = 0
 
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -455,10 +472,48 @@ class InferenceEngine:
                 self.compile_counter.record(key)
         return exe
 
+    def _call_device(self, exe, a1: np.ndarray, a2: np.ndarray,
+                     bucket: tuple, seq: int) -> np.ndarray:
+        """Run one compiled batch with transient-error retry.
+
+        Errors classified transient (:func:`is_transient_error` — flaky
+        dispatch/transport, or the injected ``device_err`` fault) are
+        retried up to ``cfg.device_retries`` times with linear backoff,
+        each retry counted (``raft_serve_device_retries_total``) and
+        logged as a ``serve_retry`` event; anything deterministic
+        (shape/dtype/compile errors) raises on the first attempt.  The
+        host-side pad/stack is NOT inside the retry: it is
+        deterministic, so re-running it could only repeat its failure.
+        """
+        attempt = 0
+        while True:
+            try:
+                if chaos.should_inject("device_err", step=seq,
+                                       point="serve.device"):
+                    raise InjectedDeviceError(
+                        f"chaos-injected transient device error "
+                        f"(batch {seq})")
+                _, flow_up = exe(self._variables, a1, a2)
+                # np.asarray blocks on the transfer — async dispatch
+                # errors surface here, inside the retry scope.
+                return np.asarray(flow_up)
+            except Exception as e:
+                if attempt >= self.cfg.device_retries \
+                        or not is_transient_error(e):
+                    raise
+                attempt += 1
+                self._counters.add_retry()
+                self._sink.emit("serve_retry",
+                                bucket=f"{bucket[0]}x{bucket[1]}",
+                                attempt=attempt,
+                                error=f"{type(e).__name__}: {e}")
+                time.sleep(self.cfg.retry_backoff_s * attempt)
+
     def _run_batch(self, bucket: tuple, reqs: List[_Request]) -> None:
         n = len(reqs)
         bs = next((s for s in self._batch_sizes if s >= n), n)
         t_start = time.perf_counter()
+        self._batch_seq += 1
         try:
             exe = self._get_executable(bucket, bs)
             im1 = [r.padder.pad_np(r.image1) for r in reqs]
@@ -466,8 +521,8 @@ class InferenceEngine:
             if bs > n:  # ballast lanes keep the compiled batch shape
                 im1 += [im1[-1]] * (bs - n)
                 im2 += [im2[-1]] * (bs - n)
-            _, flow_up = exe(self._variables, np.stack(im1), np.stack(im2))
-            flow_up = np.asarray(flow_up)
+            flow_up = self._call_device(exe, np.stack(im1), np.stack(im2),
+                                        bucket, self._batch_seq)
             t_done = time.perf_counter()
             for j, r in enumerate(reqs):
                 r.future.set_result(

@@ -93,6 +93,10 @@ class Counters:
                                    "backpressure rejections (HTTP 429)")
         self._errors = r.counter("raft_serve_batch_errors_total",
                                  "device batches that raised")
+        self._retries = r.counter(
+            "raft_serve_device_retries_total",
+            "device-call re-dispatches after a transient error "
+            "(docs/ROBUSTNESS.md)")
         self._batches = r.counter("raft_serve_batches_total",
                                   "device batches dispatched")
         self._ballast = r.counter("raft_serve_lanes_ballast_total",
@@ -117,6 +121,9 @@ class Counters:
     def add_rejected(self, n: int = 1) -> None:
         self._rejected.inc(n)
 
+    def add_retry(self, n: int = 1) -> None:
+        self._retries.inc(n)
+
     def add_batch(self, real: int, padded: int, failed: bool) -> None:
         self._batches.inc()
         self._ballast.inc(padded)
@@ -139,6 +146,7 @@ class Counters:
             "completed": completed,
             "rejected": self._rejected.value(),
             "errors": self._errors.value(),
+            "retries": self._retries.value(),
             "batches": batches,
             "failed_lanes": failed_lanes,
             "mean_batch_fill": round(real_lanes / batches, 3)

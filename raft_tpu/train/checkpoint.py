@@ -1,4 +1,4 @@
-"""Orbax checkpointing with auto-resume.
+"""Orbax checkpointing with auto-resume and torn-write fallback.
 
 Improves on the reference (SURVEY.md §5): ``torch.save(state_dict())``
 every 5000 steps kept weights only — optimizer/scheduler/step state was
@@ -8,34 +8,91 @@ is saved asynchronously, and ``restore_latest`` makes a preempted pod run
 continue exactly where it stopped.  Weights-only restore (for curriculum
 stage seeding, the reference's ``strict=False`` use case) is
 ``restore_params``.
+
+Fault tolerance (docs/ROBUSTNESS.md): a preempted host can die
+mid-write, leaving the NEWEST step directory torn — present in
+``all_steps()`` but unrestorable.  ``restore_latest`` therefore treats
+restore as the integrity check and walks the saved steps newest →
+oldest, emitting one ``ckpt_fallback`` JSONL event (+
+``raft_ckpt_fallback_total``) per step it has to skip; only when every
+step is unrestorable does it raise :class:`CheckpointRestoreError`
+(resuming silently from scratch would be worse than dying).  ``python
+-m raft_tpu verify-ckpt <dir>`` runs the same verification offline.
+The ``torn_ckpt``/``restore_err`` chaos faults exercise both paths
+deterministically (``raft_tpu/chaos``).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import re
+from typing import Any, List, Optional
 
-import jax
-import numpy as np
 import orbax.checkpoint as ocp
 
+from raft_tpu import chaos
 from raft_tpu.train.state import TrainState
+
+#: Message fingerprints of a pytree-structure mismatch between the
+#: restore template and the on-disk checkpoint (orbax wording varies by
+#: version; lenient versions don't raise at all).  Only THIS class of
+#: error means "legacy checkpoint, retry with the counter-less
+#: template" — a torn file raises decode/IO errors that must surface as
+#: corruption, not be retried against a different template and
+#: re-raised with a misleading traceback.
+_STRUCT_MISMATCH_RE = re.compile(
+    r"(?i)structure|mismatch|do(es)? not match|missing|nonfinite_steps"
+    r"|custom node type")
+
+
+def _is_structure_mismatch(e: BaseException) -> bool:
+    return isinstance(e, (ValueError, TypeError, KeyError)) \
+        and bool(_STRUCT_MISMATCH_RE.search(str(e)))
+
+
+class CheckpointRestoreError(RuntimeError):
+    """Every saved step failed to restore — nothing valid to resume
+    from.  Deliberately fatal: silently restarting a multi-day run from
+    step 0 because the checkpoint directory rotted is the worst
+    outcome, not a recovery."""
 
 
 class CheckpointManager:
-    """Thin wrapper over orbax CheckpointManager for TrainState pytrees."""
+    """Thin wrapper over orbax CheckpointManager for TrainState pytrees.
+
+    ``sink``: optional :class:`raft_tpu.obs.EventSink` for
+    ``ckpt_fallback`` events (default: the process-wide sink, a no-op
+    unless ``RAFT_TELEMETRY_DIR`` is set).
+    """
 
     def __init__(self, directory: str, max_to_keep: int = 5,
-                 async_save: bool = True):
+                 async_save: bool = True, sink=None):
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
         opts = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             enable_async_checkpointing=async_save)
         self._mgr = ocp.CheckpointManager(self._dir, options=opts)
+        self._sink = sink
+
+    def _events(self):
+        if self._sink is not None:
+            return self._sink
+        from raft_tpu.obs.events import default_sink
+
+        return default_sink()
 
     def save(self, step: int, state: TrainState, force: bool = False) -> None:
         self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+        if chaos.should_inject("torn_ckpt", step=int(step),
+                               point="ckpt.save"):
+            # Torn-write simulator: commit the save, then truncate its
+            # files — exactly what a host death mid-flush leaves behind
+            # (the step stays listed; restore raises).
+            self.wait()
+            torn = chaos.tear_files(os.path.join(self._dir, str(int(step))))
+            self._events().emit("chaos_torn_ckpt", step=int(step),
+                                files=len(torn))
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
@@ -43,24 +100,30 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def restore_latest(self, template: TrainState) -> Optional[TrainState]:
-        """Full-state restore for preemption recovery; None if no ckpt.
+    def all_steps(self) -> List[int]:
+        """Saved steps, oldest first (torn steps included — presence is
+        not integrity; see :meth:`verify`)."""
+        return sorted(int(s) for s in self._mgr.all_steps())
+
+    def _restore_step(self, step: int, template: TrainState) -> TrainState:
+        """Restore ONE step against ``template``.
 
         Checkpoints written before the non-finite guard lack the
-        ``nonfinite_steps`` counter; a structure-mismatch restore is
-        retried against a counter-less template and the counter
-        re-attached at zero, so old run directories resume cleanly."""
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
+        ``nonfinite_steps`` counter; a structure-mismatch restore (and
+        ONLY that — see ``_is_structure_mismatch``) is retried against
+        a counter-less template and the counter re-attached at zero, so
+        old run directories resume cleanly while genuine corruption
+        surfaces with its original traceback."""
+        if chaos.should_inject("restore_err", step=int(step),
+                               point="ckpt.restore"):
+            raise chaos.InjectedCheckpointCorruption(
+                f"chaos-injected restore failure at step {step}")
         has_counter = getattr(template, "nonfinite_steps", None) is not None
         try:
             st = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(template))
-        except Exception:
-            # Stricter orbax versions raise on the structure mismatch;
-            # retry against the legacy (counter-less) template.
-            if not has_counter:
+        except Exception as e:
+            if not (has_counter and _is_structure_mismatch(e)):
                 raise
             st = self._mgr.restore(
                 step,
@@ -73,6 +136,74 @@ class CheckpointManager:
 
             st = st.replace(nonfinite_steps=jnp.zeros((), jnp.int32))
         return st
+
+    def restore_latest(self, template: TrainState) -> Optional[TrainState]:
+        """Full-state restore for preemption recovery; None if no ckpt.
+
+        Walks saved steps newest → oldest past corrupt/torn ones
+        (``ckpt_fallback`` event + ``raft_ckpt_fallback_total`` counter
+        per skipped step); raises :class:`CheckpointRestoreError` when
+        nothing restores."""
+        steps = sorted(self.all_steps(), reverse=True)
+        if not steps:
+            return None
+        failures = []
+        for step in steps:
+            try:
+                st = self._restore_step(step, template)
+            except Exception as e:
+                failures.append((step, e))
+                self._note_fallback(step, e, tried=len(failures),
+                                    remaining=len(steps) - len(failures))
+                continue
+            if failures:
+                print(f"checkpoint fallback: step(s) "
+                      f"{[s for s, _ in failures]} unrestorable "
+                      f"(torn write?); resumed from step {step}",
+                      flush=True)
+            return st
+        raise CheckpointRestoreError(
+            f"no restorable checkpoint in {self._dir} — all "
+            f"{len(steps)} step(s) failed: "
+            + "; ".join(f"step {s}: {type(e).__name__}: {str(e)[:120]}"
+                        for s, e in failures))
+
+    def _note_fallback(self, step: int, e: BaseException, *,
+                       tried: int, remaining: int) -> None:
+        from raft_tpu.obs.registry import default_registry
+
+        default_registry().counter(
+            "raft_ckpt_fallback_total",
+            "saved checkpoint steps skipped as unrestorable during "
+            "resume").inc()
+        self._events().emit("ckpt_fallback", step=int(step),
+                            error=f"{type(e).__name__}: {str(e)[:200]}",
+                            tried=tried, remaining_steps=remaining)
+
+    def verify(self, step: int,
+               template: Optional[TrainState] = None) -> dict:
+        """Integrity-check one saved step by actually restoring it (the
+        only check that proves the bytes decode).  With no ``template``
+        the raw metadata-driven restore is used, so verification needs
+        no model code.  Returns ``{step, ok[, error]}``; never raises."""
+        try:
+            if template is None:
+                # Explicit StandardRestore: a freshly opened manager
+                # (the verify CLI) has no handler registry yet, and the
+                # bare restore(step) would fail for the wrong reason.
+                self._mgr.restore(step,
+                                  args=ocp.args.StandardRestore())
+            else:
+                self._restore_step(step, template)
+            return {"step": int(step), "ok": True}
+        except Exception as e:
+            return {"step": int(step), "ok": False,
+                    "error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+    def verify_all(self,
+                   template: Optional[TrainState] = None) -> List[dict]:
+        """:meth:`verify` over every saved step, oldest first."""
+        return [self.verify(s, template) for s in self.all_steps()]
 
     def restore_params(self, template: TrainState) -> Optional[Any]:
         """Weights(+batch_stats)-only restore: seeds the next curriculum

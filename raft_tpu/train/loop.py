@@ -153,8 +153,20 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             batch_stats=restore_params.get("batch_stats", state.batch_stats))
     print(f"Parameter Count: {state.param_count()}", flush=True)
 
+    # Telemetry first: the checkpoint manager's ckpt_fallback events and
+    # the loader's sample_quarantine events (docs/ROBUSTNESS.md) must
+    # land in the same JSONL stream as the per-step records — resume
+    # fallback happens BEFORE the first step is ever timed.
+    telem = TrainTelemetry(telemetry_dir, batch_size=cfg.batch_size,
+                           num_devices=max(jax.device_count(), 1),
+                           image_size=cfg.image_size)
+    if loader is not None and telem.enabled:
+        loader.sink = telem.sink
+        loader.registry = telem.registry
+
     ckpt_dir = os.path.join(cfg.ckpt_dir, cfg.name)
-    mgr = CheckpointManager(ckpt_dir)
+    mgr = CheckpointManager(ckpt_dir,
+                            sink=telem.sink if telem.enabled else None)
     resumed = mgr.restore_latest(state)
     if resumed is not None:
         state = resumed
@@ -178,9 +190,6 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             np.random.SeedSequence([cfg.seed + 1, step]))
         prep_fn = functools.partial(add_image_noise, noise_rng)
     profiler = StepProfiler(profile_dir)
-    telem = TrainTelemetry(telemetry_dir, batch_size=cfg.batch_size,
-                           num_devices=max(jax.device_count(), 1),
-                           image_size=cfg.image_size)
     telem.start(start_step=step, num_steps=cfg.num_steps)
     # Training health (docs/OBSERVABILITY.md "Training health"): the
     # monitor is fed by the Logger's once-per-interval flush — the only

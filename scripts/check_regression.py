@@ -63,6 +63,14 @@ def parse_args(argv=None):
     p.add_argument("--min-vs-baseline", type=float, default=None,
                    help="fail when the newest vs_baseline is below "
                         "this floor (unset = no check)")
+    p.add_argument("--max-quarantined", type=int, default=0,
+                   help="fail when a newest record's "
+                        "config.quarantined_total exceeds this (silent "
+                        "data rot gate; docs/ROBUSTNESS.md)")
+    p.add_argument("--max-ckpt-fallback", type=int, default=0,
+                   help="fail when a newest record's "
+                        "config.ckpt_fallback_total exceeds this "
+                        "(torn-checkpoint gate)")
     p.add_argument("--tiny", action="store_true",
                    help="self-test on synthetic series (CPU smoke; "
                         "exercises the pass, drop and nonfinite paths)")
@@ -95,7 +103,8 @@ def build_series(paths):
     return series
 
 
-def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None):
+def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
+          max_quarantined=0, max_ckpt_fallback=0):
     """``(failures, report)`` over the newest record of each metric."""
     failures, report = [], []
     for metric, recs in sorted(series.items()):
@@ -109,6 +118,22 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None):
             failures.append(
                 f"{metric}: nonfinite_steps_total={int(nf)} — the run "
                 "hit the non-finite guard; its numbers are not clean")
+        # Fault-tolerance gates (docs/ROBUSTNESS.md): a run that had to
+        # quarantine samples or walk past torn checkpoints produced a
+        # number, but the number hides rot — fail unless the budget
+        # says otherwise (chaos drills pass explicit budgets).
+        q = cfg.get("quarantined_total")
+        if isinstance(q, (int, float)) and q > max_quarantined:
+            failures.append(
+                f"{metric}: quarantined_total={int(q)} > "
+                f"{max_quarantined} — samples were silently skipped "
+                "(data rot or a chaos drill without a budget)")
+        fb = cfg.get("ckpt_fallback_total")
+        if isinstance(fb, (int, float)) and fb > max_ckpt_fallback:
+            failures.append(
+                f"{metric}: ckpt_fallback_total={int(fb)} > "
+                f"{max_ckpt_fallback} — resume skipped torn "
+                "checkpoint step(s)")
         if value is None:
             entry["skipped"] = "value null (backend unavailable)"
             report.append(entry)
@@ -139,22 +164,27 @@ def _selftest() -> int:
     """The gate gating itself: synthetic series through the real
     file-loading path."""
 
-    def run(values, nonfinite_last=0, drop_pct=10.0):
+    def run(values, nonfinite_last=0, drop_pct=10.0, last_cfg=None,
+            **gate_kw):
         with tempfile.TemporaryDirectory() as td:
             paths = []
             for i, v in enumerate(values):
                 rec = {"metric": "train_throughput_tiny", "value": v,
                        "unit": "image-pairs/sec/chip", "vs_baseline": 0.0,
                        "config": {}}
-                if i == len(values) - 1 and nonfinite_last:
-                    rec["config"]["nonfinite_steps_total"] = nonfinite_last
+                if i == len(values) - 1:
+                    if nonfinite_last:
+                        rec["config"]["nonfinite_steps_total"] = \
+                            nonfinite_last
+                    rec["config"].update(last_cfg or {})
                 if i % 2:  # alternate raw and driver-wrapped envelopes
                     rec = {"n": i, "rc": 0, "parsed": rec}
                 p = os.path.join(td, f"BENCH_r{i:02d}.json")
                 with open(p, "w") as f:
                     json.dump(rec, f)
                 paths.append(p)
-            return check(build_series(paths), max_drop_pct=drop_pct)
+            return check(build_series(paths), max_drop_pct=drop_pct,
+                         **gate_kw)
 
     cases = [
         ("flat series passes", run([30.0, 31.0, 30.5]), False),
@@ -163,6 +193,19 @@ def _selftest() -> int:
          True),
         ("null value never gates", run([30.0, 31.0, None]), False),
         ("single record passes", run([30.0]), False),
+        ("quarantine fails", run([30.0, 31.0, 30.5],
+                                 last_cfg={"quarantined_total": 3}),
+         True),
+        ("quarantine within budget passes",
+         run([30.0, 31.0, 30.5], last_cfg={"quarantined_total": 3},
+             max_quarantined=3), False),
+        ("ckpt fallback fails", run([30.0, 31.0, 30.5],
+                                    last_cfg={"ckpt_fallback_total": 1}),
+         True),
+        ("zero fault totals pass",
+         run([30.0, 31.0, 30.5], last_cfg={"quarantined_total": 0,
+                                           "ckpt_fallback_total": 0}),
+         False),
     ]
     bad = [name for name, (failures, _), want_fail in cases
            if bool(failures) != want_fail]
@@ -188,7 +231,9 @@ def main(argv=None):
     failures, report = check(build_series(paths),
                              max_drop_pct=args.max_drop_pct,
                              window=args.window,
-                             min_vs_baseline=args.min_vs_baseline)
+                             min_vs_baseline=args.min_vs_baseline,
+                             max_quarantined=args.max_quarantined,
+                             max_ckpt_fallback=args.max_ckpt_fallback)
     print(json.dumps({"ok": not failures, "failures": failures,
                       "checked": report}))
     if failures:

@@ -66,11 +66,19 @@ def iter_records(path):
 
 
 def last_run(records):
-    """``(run_config, [train_step...], [train_health...])`` of the LAST
-    run in the log (files append across runs; run_config marks each
-    start).  Logs from builds without training-health telemetry simply
-    yield an empty health list."""
+    """``(run_config, [train_step...], [train_health...], faults)`` of
+    the LAST run in the log (files append across runs; run_config marks
+    each start).  Logs from builds without training-health telemetry
+    simply yield an empty health list.
+
+    ``faults`` counts the fault-tolerance events (docs/ROBUSTNESS.md)
+    over the WHOLE log, not just the last run: resume fallback fires
+    BEFORE the resumed run's run_config is written, and a quarantined
+    sample is data rot regardless of which restart hit it — the
+    check_regression gate wants the conservative total."""
     run_cfg, steps, health = None, [], []
+    faults = {"sample_quarantine": 0, "ckpt_fallback": 0,
+              "serve_retry": 0, "chaos_inject": 0}
     for rec in records:
         ev = rec.get("event")
         if ev == "run_config":
@@ -79,7 +87,9 @@ def last_run(records):
             steps.append(rec)
         elif ev == "train_health":
             health.append(rec)
-    return run_cfg, steps, health
+        elif ev in faults:
+            faults[ev] += 1
+    return run_cfg, steps, health, faults
 
 
 def _wait_s(rec):
@@ -91,7 +101,7 @@ def _wait_s(rec):
     return rec.get("queue_wait_s", rec.get("data_wait_s", 0.0))
 
 
-def summarize(run_cfg, steps, health=None, skip=2):
+def summarize(run_cfg, steps, health=None, faults=None, skip=2):
     if run_cfg is None:
         raise SystemExit("no run_config event in log (telemetry written "
                          "by an older build?) — cannot recover batch "
@@ -115,7 +125,18 @@ def summarize(run_cfg, steps, health=None, skip=2):
     # scripts/check_regression.py; the final update-ratio and per-
     # iteration EPE curve summarize where the run's numerics ended up.
     # Old logs without the event just omit the fields.
+    # Fault-tolerance whole-log totals (docs/ROBUSTNESS.md): quarantined
+    # samples and checkpoint-fallback steps are silent data/state rot a
+    # bench number would otherwise hide; check_regression gates on them
+    # (--max-quarantined / --max-ckpt-fallback).  chaos_injected
+    # distinguishes a chaos drill from organic rot.
     health_cfg = {}
+    if faults is not None:
+        health_cfg["quarantined_total"] = faults.get(
+            "sample_quarantine", 0)
+        health_cfg["ckpt_fallback_total"] = faults.get("ckpt_fallback", 0)
+        if faults.get("chaos_inject"):
+            health_cfg["chaos_injected_total"] = faults["chaos_inject"]
     last_health = (health or [None])[-1]
     if last_health is not None:
         health_cfg["nonfinite_steps_total"] = last_health.get(
@@ -150,8 +171,9 @@ def summarize(run_cfg, steps, health=None, skip=2):
 
 def main(argv=None):
     args = parse_args(argv)
-    run_cfg, steps, health = last_run(iter_records(args.path))
-    print(json.dumps(summarize(run_cfg, steps, health, skip=args.skip)))
+    run_cfg, steps, health, faults = last_run(iter_records(args.path))
+    print(json.dumps(summarize(run_cfg, steps, health, faults,
+                               skip=args.skip)))
 
 
 if __name__ == "__main__":

@@ -208,6 +208,39 @@ def test_prefetch_producer_error_propagates():
         DevicePipeline(iter(()), depth=-1)
 
 
+def test_prefetch_producer_crash_preserves_type_and_close_joins():
+    """Producer-crash semantics (the prefetch.py error-relay path): a
+    producer that raises mid-stream re-raises the ORIGINAL exception
+    object in the consumer's next(), and close() afterwards returns
+    promptly with the thread joined — no hang, no leaked thread,
+    idempotent."""
+
+    class BoomError(Exception):
+        pass
+
+    boom = BoomError("mid-stream decode crash")
+
+    def src():
+        yield {"x": np.zeros((4,), np.float32)}
+        yield {"x": np.ones((4,), np.float32)}
+        raise boom
+
+    pipe = DevicePipeline(src(), depth=2)
+    next(pipe)
+    next(pipe)
+    with pytest.raises(BoomError) as ei:
+        next(pipe)
+    assert ei.value is boom  # the original object, not a re-wrap
+    # after the error the pipeline is closed and stays closed
+    with pytest.raises(StopIteration):
+        next(pipe)
+    t0 = time.perf_counter()
+    pipe.close()
+    pipe.close()  # idempotent
+    assert time.perf_counter() - t0 < 5.0
+    assert not pipe._thread.is_alive()
+
+
 # ---------------------------------------------------------------------
 # the overlap acceptance criterion
 # ---------------------------------------------------------------------

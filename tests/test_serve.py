@@ -143,6 +143,48 @@ def test_healthz_readiness_reports_device_stall(variables):
         eng.stop()
 
 
+def test_stop_drain_timeout_with_wedged_device_call(variables):
+    """stop(drain=True) while a device call is wedged: the drain times
+    out instead of spinning forever, requests still queued in the
+    dispatcher fail with 'engine stopped', the wedged batch's requests
+    get the device error, and the whole shutdown (loop thread joined,
+    device pool drained) completes inside the 10 s join bound."""
+    import time
+
+    eng = InferenceEngine(variables, CFG, ServeConfig(
+        iters=ITERS, max_batch=2, batch_sizes=(2,), max_wait_ms=4000,
+        max_queue=8, device_retries=0))
+    eng.start()
+
+    def wedged_exe(v, a1, a2):
+        time.sleep(1.5)  # wedged, but finite: the pool must join
+        raise RuntimeError("device wedged")
+
+    eng._get_executable = lambda bucket, bs: wedged_exe
+    rng = np.random.default_rng(5)
+    im1, im2 = _images(rng, 36, 52)
+    f1 = eng.submit(im1, im2)
+    f2 = eng.submit(im1, im2)   # fills the batch of 2 -> device, wedged
+    time.sleep(0.3)             # let the batch reach the worker
+    f3 = eng.submit(im1, im2)   # held open by the dispatcher (max_wait)
+
+    t0 = time.perf_counter()
+    eng.stop(drain=True, timeout=0.4)   # drain cannot finish: times out
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 10.0, elapsed
+    assert eng._thread is None  # loop thread joined
+
+    with pytest.raises(RuntimeError, match="engine stopped"):
+        f3.result(timeout=1)
+    for f in (f1, f2):          # the wedged batch fails with its error
+        with pytest.raises(RuntimeError, match="device wedged"):
+            f.result(timeout=1)
+    stats = eng.stats()
+    assert stats["errors"] == 1 and stats["pending"] == 0
+    with pytest.raises(RuntimeError):  # no accepting after stop
+        eng.submit(im1, im2)
+
+
 def test_backpressure_rejects_past_max_queue(variables):
     """With the dispatcher holding batches open (long max_wait_ms), the
     ``max_queue``+1-th submit is rejected immediately — the queue is
