@@ -99,6 +99,11 @@ def bench_eval():
                    and os.environ.get("BENCH_CORR_IMPL",
                                       "allpairs") == "allpairs")
     eval_target = 12.97 if default_cfg else None
+    # Tuning-registry provenance of the eval arm (make_eval_fn consults
+    # the 'eval' entries; this records whether one applied).
+    from raft_tpu import tuning
+
+    _, tinfo = tuning.resolve_config(cfg, ("eval",), (H, W), 1)
     print(json.dumps({
         "metric": f"eval_forward_sintel_440x1024_bf16_iters{iters}",
         "value": round(n / dt, 3),
@@ -106,6 +111,7 @@ def bench_eval():
         "vs_baseline": (round(n / dt / eval_target, 3) if eval_target
                         else 0.0),
         "baseline_frames_per_sec": eval_target or "n/a (non-default cfg)",
+        "config": dict(tinfo.stamp()),
     }))
 
 
@@ -127,48 +133,82 @@ def main():
     # 16 -> 18.4; 24 regressed under the XLA path (HBM pressure).
     per_chip_batch = int(os.environ.get("BENCH_BATCH", 16))
     B = per_chip_batch * n_dev
-    # allpairs_pallas: materialized pyramid + fused Pallas window sampling
-    # — fastest measured training path (17.5 vs 16.2 pairs/s/chip over
-    # the XLA einsum lookup at batch 12).  The pallas/chunked impls trade
-    # speed for O((HW)^2) memory, like the reference's alternate corr
-    # (README.md:75-80).
-    corr_impl = os.environ.get("BENCH_CORR_IMPL", "allpairs_pallas")
-    corr_precision = os.environ.get("BENCH_CORR_PRECISION", "highest")
-    # remat off is fastest at the chairs bench shape now that the flat
-    # fused loss + query-minor pyramid freed the activation memory
-    # (59.5 vs 55.8 pairs/s/chip with save_corr, round 2); larger crops
-    # or batches should keep save_corr (the model default).
-    remat = os.environ.get("BENCH_REMAT", "0") == "1"
     _defaults = RAFTConfig()
-    remat_policy = os.environ.get("BENCH_REMAT_POLICY",
-                                  _defaults.remat_policy)
-    scan_unroll = int(os.environ.get("BENCH_SCAN_UNROLL",
-                                     _defaults.scan_unroll))
+    # Bench-curated knob defaults (the hand-tuned r03 winners at the
+    # chairs shape, BENCH_r03.json): allpairs_pallas materialized
+    # pyramid + fused Pallas sampling (17.5 vs 16.2 pairs/s/chip over
+    # the XLA lookup at batch 12; pallas/chunked trade speed for
+    # O((HW)^2) memory); remat/remat_upsample OFF win at this shape now
+    # that the flat fused loss + query-minor pyramid freed the
+    # activation memory (59.5 vs 55.8 round 2, 74.6 vs 73.9 round 3) —
+    # the MODEL defaults stay remat-on, safe for big crops.
+    knobs = {
+        "corr_impl": "allpairs_pallas",
+        "corr_precision": "highest",
+        "corr_dtype": _defaults.corr_dtype,
+        "remat": False,
+        "remat_policy": _defaults.remat_policy,
+        "scan_unroll": _defaults.scan_unroll,
+        "lookup_block_q": _defaults.lookup_block_q,
+        "remat_upsample": False,
+        "upsample_group": _defaults.upsample_group,
+        "upsample_unroll": _defaults.upsample_unroll,
+        "upsample_dtype": _defaults.upsample_dtype,
+        "fuse_upsample_in_scan": _defaults.fuse_upsample_in_scan,
+        "upsample_loss_kernel": _defaults.upsample_loss_kernel,
+    }
+    # Knob resolution, highest precedence first: BENCH_* env (a hand-set
+    # knob), then the per-hardware tuning registry (raft_tpu/tuning.py —
+    # scripts/autotune.py winners for this (device, shape, batch)), then
+    # the curated defaults above.  The emitted config says which
+    # (tuned/tuning_key/tuning_registry_hash), so BENCH_r0x series are
+    # attributable to autotune vs hand-tuning.
+    env_knobs = {
+        "corr_impl": "BENCH_CORR_IMPL",
+        "corr_precision": "BENCH_CORR_PRECISION",
+        "corr_dtype": "BENCH_CORR_DTYPE",
+        "remat": "BENCH_REMAT",
+        "remat_policy": "BENCH_REMAT_POLICY",
+        "scan_unroll": "BENCH_SCAN_UNROLL",
+        "lookup_block_q": "BENCH_LOOKUP_BLOCK_Q",
+        "remat_upsample": "BENCH_REMAT_UPSAMPLE",
+        "upsample_group": "BENCH_UPSAMPLE_GROUP",
+        "upsample_unroll": "BENCH_UPSAMPLE_UNROLL",
+        "upsample_dtype": "BENCH_UPSAMPLE_DTYPE",
+        "fuse_upsample_in_scan": "BENCH_FUSE_UPSAMPLE",
+        "upsample_loss_kernel": "BENCH_UPSAMPLE_KERNEL",
+    }
+    _bools = {"remat", "remat_upsample", "fuse_upsample_in_scan"}
+    _ints = {"scan_unroll", "lookup_block_q", "upsample_group",
+             "upsample_unroll"}
+    hand_set = {}
+    for knob, env in env_knobs.items():
+        if env in os.environ:
+            raw = os.environ[env]
+            hand_set[knob] = (raw == "1" if knob in _bools
+                              else int(raw) if knob in _ints else raw)
+
+    from raft_tpu import tuning
+
+    tuning_stamp = {"tuned": False}
+    if tuning.enabled():
+        hit = tuning.lookup("train", (H, W), per_chip_batch)
+        if hit is not None:
+            key, entry, exact = hit
+            for knob, value in entry.get("knobs", {}).items():
+                if knob in knobs and knob not in hand_set:
+                    knobs[knob] = value
+            info = tuning.TuningInfo(
+                tuned=True, key=key, exact=exact,
+                registry_hash=tuning.registry_file_hash())
+            tuning_stamp = info.stamp()
+    knobs.update(hand_set)
+
     compute_dtype = os.environ.get("BENCH_COMPUTE_DTYPE", "bfloat16")
-    model_cfg = RAFTConfig.full(
-        compute_dtype=compute_dtype, corr_impl=corr_impl,
-        corr_precision=corr_precision,
-        corr_dtype=os.environ.get("BENCH_CORR_DTYPE", _defaults.corr_dtype),
-        remat=remat,
-        remat_policy=remat_policy, scan_unroll=scan_unroll,
-        lookup_block_q=int(os.environ.get("BENCH_LOOKUP_BLOCK_Q",
-                                          _defaults.lookup_block_q)),
-        # Upsample remat re-measured OFF-wins at the chairs bench shape
-        # once the bf16 upsample chain freed its residual memory (74.6
-        # vs 73.9 round 3); the MODEL default stays True (safe for big
-        # crops/batches).
-        remat_upsample=os.environ.get("BENCH_REMAT_UPSAMPLE", "0") == "1",
-        upsample_group=int(os.environ.get("BENCH_UPSAMPLE_GROUP",
-                                          _defaults.upsample_group)),
-        upsample_unroll=int(os.environ.get("BENCH_UPSAMPLE_UNROLL",
-                                           _defaults.upsample_unroll)),
-        upsample_dtype=os.environ.get("BENCH_UPSAMPLE_DTYPE",
-                                      _defaults.upsample_dtype),
-        fuse_upsample_in_scan=os.environ.get(
-            "BENCH_FUSE_UPSAMPLE",
-            "1" if _defaults.fuse_upsample_in_scan else "0") == "1",
-        upsample_loss_kernel=os.environ.get("BENCH_UPSAMPLE_KERNEL",
-                                            _defaults.upsample_loss_kernel))
+    model_cfg = RAFTConfig.full(compute_dtype=compute_dtype, **knobs)
+    corr_impl, corr_precision = knobs["corr_impl"], knobs["corr_precision"]
+    remat, remat_policy = knobs["remat"], knobs["remat_policy"]
+    scan_unroll = knobs["scan_unroll"]
     cfg = TrainConfig(num_steps=1000, batch_size=B, image_size=(H, W),
                       iters=12)
 
@@ -218,13 +258,19 @@ def main():
         # defaults remat=0/remat_upsample=0, which won at this shape;
         # the model ships save_corr/remat_upsample=1 — safe for big
         # crops).  Recorded so BENCH_*.json A/Bs across rounds always
-        # say what configuration they measured.
+        # say what configuration they measured — including WHERE the
+        # knobs came from: `tuned: true` + registry key + file hash
+        # means autotune set them, `tuned: false` means hand-set/curated
+        # defaults (scripts/check_regression.py --require-tuned gates
+        # on this).
         "config": {"batch_per_chip": per_chip_batch, "corr_impl": corr_impl,
+                   "corr_dtype": model_cfg.corr_dtype,
                    "remat": remat,
                    "remat_upsample": model_cfg.remat_upsample,
                    "scan_unroll": scan_unroll,
                    "fuse_upsample_in_scan": model_cfg.fuse_upsample_in_scan,
-                   "upsample_loss_kernel": model_cfg.upsample_loss_kernel},
+                   "upsample_loss_kernel": model_cfg.upsample_loss_kernel,
+                   **tuning_stamp},
     }))
 
 

@@ -9,6 +9,40 @@ from __future__ import annotations
 
 import argparse
 
+# config.py is jax-free by design, so importing the validators here keeps
+# `--help` (and argparse errors) instant.
+from raft_tpu.config import validate_corr_dtype, validate_corr_precision
+
+
+def _corr_dtype_arg(value: str) -> str:
+    """Validate at the CLI edge: a typo'd dtype fails HERE with the
+    allowed set in the message, not minutes later inside
+    ``jnp.dtype(...)`` at trace time."""
+    try:
+        return validate_corr_dtype(value)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+
+
+def _corr_precision_arg(value: str) -> str:
+    try:
+        return validate_corr_precision(value)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+
+
+def _epe_delta_arg(value: str):
+    dtypes = [d.strip() for d in value.split(",") if d.strip()]
+    if len(dtypes) < 2:
+        raise argparse.ArgumentTypeError(
+            f"--epe_delta needs a comma list of >= 2 corr dtypes "
+            f"(e.g. 'float32,int8'), got {value!r}")
+    try:
+        return [validate_corr_dtype(d, flag="--epe_delta")
+                for d in dtypes]
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="RAFT-TPU evaluation")
@@ -17,6 +51,23 @@ def parse_args(argv=None):
                    choices=["chairs", "sintel", "kitti"])
     p.add_argument("--small", action="store_true")
     p.add_argument("--precision", default="bf16", choices=["bf16", "fp32"])
+    p.add_argument("--corr_dtype", default="auto", type=_corr_dtype_arg,
+                   help="correlation-volume STORAGE dtype (auto / "
+                        "float32 / bfloat16 / int8 / fp8 names); "
+                        "quantized dtypes need a materialized corr_impl "
+                        "and should be gated with --epe_delta "
+                        "(docs/PERFORMANCE.md)")
+    p.add_argument("--corr_precision", default="auto",
+                   type=_corr_precision_arg,
+                   help="MXU precision of the correlation einsums "
+                        "(auto / default / high / highest)")
+    p.add_argument("--epe_delta", default=None, type=_epe_delta_arg,
+                   metavar="DTYPE,DTYPE[,...]",
+                   help="accuracy-gate mode: run the SAME checkpoint "
+                        "under each corr storage dtype and report "
+                        "per-metric deltas against the first (e.g. "
+                        "'float32,int8' gates int8 against fp32 "
+                        "storage); overrides --corr_dtype")
     p.add_argument("--alternate_corr", action="store_true",
                    help="memory-efficient on-demand correlation "
                         "(reference --alternate_corr)")
@@ -81,6 +132,8 @@ def main(argv=None):
     compute_dtype = "bfloat16" if args.precision == "bf16" else "float32"
     mk = RAFTConfig.small_model if args.small else RAFTConfig.full
     model_cfg = mk(compute_dtype=compute_dtype,
+                   corr_dtype=args.corr_dtype,
+                   corr_precision=args.corr_precision,
                    corr_impl=evaluate.default_alternate_corr_impl()
                    if args.alternate_corr else "allpairs")
     variables = load_model_variables(args.model)
@@ -89,6 +142,25 @@ def main(argv=None):
 
     default_iters = {"chairs": 24, "sintel": 32, "kitti": 24}
     iters = args.iters or default_iters[args.dataset]
+
+    roots = {
+        "chairs": dict(root=osp.join(args.data_root,
+                                     "FlyingChairs_release/data"),
+                       split_file=args.chairs_split),
+        "sintel": dict(root=osp.join(args.data_root, "Sintel")),
+        "kitti": dict(root=osp.join(args.data_root, "KITTI")),
+    }
+    if args.epe_delta:
+        # The quantization accuracy gate: same checkpoint, N corr
+        # storage dtypes, per-metric deltas vs the first.
+        kwargs = dict(roots[args.dataset])
+        if args.dataset == "kitti":
+            kwargs["bucket"] = not args.no_bucket
+        evaluate.evaluate_epe_delta(
+            variables, model_cfg, args.epe_delta, dataset=args.dataset,
+            iters=iters, batch_size=args.eval_batch, **kwargs)
+        return
+
     if args.dataset == "chairs":
         evaluate.validate_chairs(
             variables, model_cfg, iters=iters,

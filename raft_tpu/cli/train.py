@@ -20,6 +20,25 @@ import functools
 import os
 import os.path as osp
 
+# config.py is jax-free by design; validating the corr knobs at the
+# argparse edge means a typo names the allowed set immediately instead
+# of dying inside ``jnp.dtype(...)`` at trace time.
+from raft_tpu.config import validate_corr_dtype, validate_corr_precision
+
+
+def _corr_dtype_arg(value: str) -> str:
+    try:
+        return validate_corr_dtype(value)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+
+
+def _corr_precision_arg(value: str) -> str:
+    try:
+        return validate_corr_precision(value)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="RAFT-TPU training")
@@ -81,12 +100,20 @@ def parse_args(argv=None):
                         "work so unroll buys nothing and the 12x graph "
                         "can crash the compiler (round-4 lesson) — or "
                         "on CPU where the unrolled compile is minutes")
-    p.add_argument("--corr_dtype", default="auto",
-                   choices=["auto", "float32", "bfloat16"],
+    p.add_argument("--corr_dtype", default="auto", type=_corr_dtype_arg,
                    help="materialized corr-pyramid storage dtype; 'auto' "
                         "follows the compute dtype (bf16 storage under "
                         "bf16 compute), 'float32' pins fp32 like the "
-                        "reference (core/corr.py:50)")
+                        "reference (core/corr.py:50); 'int8'/fp8 names "
+                        "store the volume quantized with a calibrated "
+                        "per-level scale — inference-focused, gate with "
+                        "`evaluate --epe_delta float32,int8` "
+                        "(docs/PERFORMANCE.md)")
+    p.add_argument("--corr_precision", default="auto",
+                   type=_corr_precision_arg,
+                   help="MXU precision of the correlation einsums "
+                        "(auto / default / high / highest; 'auto' = "
+                        "'highest', the measured v5e winner)")
     p.add_argument("--corr_impl", default="auto",
                    choices=["auto", "allpairs", "allpairs_pallas",
                             "chunked", "pallas"],
@@ -262,10 +289,20 @@ def main(argv=None):
     if corr_impl == "auto":
         corr_impl = ("allpairs_pallas" if jax.default_backend() == "tpu"
                      else "allpairs")
+    from raft_tpu.config import QUANTIZED_CORR_DTYPES
+
+    if (args.corr_dtype in QUANTIZED_CORR_DTYPES
+            and corr_impl in ("chunked", "pallas")):
+        raise SystemExit(
+            f"--corr_dtype {args.corr_dtype} requires a materialized "
+            f"correlation pyramid (--corr_impl allpairs or "
+            f"allpairs_pallas); the on-demand {corr_impl!r} path never "
+            "stores the volume, so there is nothing to quantize")
     mk = RAFTConfig.small_model if args.small else RAFTConfig.full
     model_cfg = mk(dropout=args.dropout, corr_impl=corr_impl,
                    compute_dtype=compute_dtype,
                    corr_dtype=args.corr_dtype,
+                   corr_precision=args.corr_precision,
                    remat=args.remat != "none",
                    remat_policy=args.remat if args.remat != "none"
                    else "save_corr",

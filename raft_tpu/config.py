@@ -17,6 +17,46 @@ import numpy as np
 
 _warned_fallback = set()
 
+# The full knob vocabulary for the correlation-volume STORAGE dtype and
+# the MXU precision of the correlation einsums.  One tuple each, shared
+# by the CLI edges (cli/train.py, cli/evaluate.py) and the config
+# resolution below, so a typo fails at argument parsing with the
+# allowed set in the message instead of minutes later inside
+# ``jnp.dtype(...)`` at trace time.
+#
+# 'int8' (and the fp8 names) are QUANTIZED storage: per-level symmetric
+# scale calibrated from the correlation row maxima, fp32 accumulation
+# in the lookups, dequant fused into the window sampling
+# (raft_tpu/ops/corr.py).  They require a materialized pyramid
+# (corr_impl 'allpairs' or 'allpairs_pallas') — the on-demand paths
+# never store the volume, so there is nothing to quantize.
+CORR_DTYPES = ("auto", "float32", "bfloat16", "int8",
+               "float8_e4m3fn", "float8_e5m2")
+QUANTIZED_CORR_DTYPES = ("int8", "float8_e4m3fn", "float8_e5m2")
+CORR_PRECISIONS = ("auto", "default", "high", "highest")
+
+
+def validate_corr_dtype(value: str, flag: str = "corr_dtype") -> str:
+    """Validate a corr-storage dtype at the CLI edge.
+
+    Raises ``ValueError`` naming the allowed set — the alternative is an
+    opaque trace-time ``jnp.dtype`` failure from deep inside the model.
+    """
+    if value not in CORR_DTYPES:
+        raise ValueError(
+            f"invalid {flag}={value!r}; allowed: {', '.join(CORR_DTYPES)}")
+    return value
+
+
+def validate_corr_precision(value: str,
+                            flag: str = "corr_precision") -> str:
+    """Validate the correlation MXU precision at the CLI edge."""
+    if value not in CORR_PRECISIONS:
+        raise ValueError(
+            f"invalid {flag}={value!r}; allowed: "
+            f"{', '.join(CORR_PRECISIONS)}")
+    return value
+
 
 def _warn_pallas_fallback(requested: str, substituted: str) -> None:
     """One warning per (requested, substituted) pair per process: the
@@ -88,6 +128,16 @@ class RAFTConfig:
     # Real-data full-stage EPE remains the definitive test
     # (docs/REAL_WEIGHTS_RUNBOOK.md); quality-critical runs can still
     # pin 'float32' (~7% throughput give-back).
+    # 'int8' / 'float8_e4m3fn' / 'float8_e5m2' store the pyramid
+    # QUANTIZED with a per-level symmetric scale calibrated from the
+    # correlation row maxima; lookups dequantize in the sampling pass
+    # and accumulate fp32 (docs/PERFORMANCE.md "Quantized correlation").
+    # Inference/serving-focused: the quantize boundary is
+    # non-differentiable (stop_gradient, like the reference's unwired
+    # alt_cuda_corr backward), so under training the feature encoder
+    # receives no gradient through the correlation volume.  Gate any
+    # quantized run with the eval EPE-delta mode
+    # (``python -m raft_tpu evaluate --epe_delta float32,int8``).
     corr_dtype: str = "auto"
     # MXU precision for the correlation matmul + window-sampling einsums:
     # 'default' (1 bf16 pass), 'high' (bf16x3), 'highest' (fp32), or
@@ -190,13 +240,21 @@ class RAFTConfig:
 
     @property
     def resolved_corr_dtype(self) -> str:
+        validate_corr_dtype(self.corr_dtype)
         if self.corr_dtype == "auto":
             return ("bfloat16" if self.compute_dtype == "bfloat16"
                     else "float32")
         return self.corr_dtype
 
     @property
+    def corr_dtype_is_quantized(self) -> bool:
+        """True when the resolved storage dtype needs the calibrated
+        per-level scale plumbing (int8 / fp8)."""
+        return self.resolved_corr_dtype in QUANTIZED_CORR_DTYPES
+
+    @property
     def resolved_corr_precision(self) -> str:
+        validate_corr_precision(self.corr_precision)
         if self.corr_precision == "auto":
             return "highest"   # measured fastest on v5e (see above)
         return self.corr_precision

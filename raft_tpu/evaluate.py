@@ -46,7 +46,9 @@ def default_alternate_corr_impl() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "chunked"
 
 
-def make_inference_model(model_cfg: RAFTConfig) -> RAFT:
+def make_inference_model(model_cfg: RAFTConfig, *,
+                         bucket_hw=None, batch=None,
+                         tuning_kind=("eval",)) -> RAFT:
     """The RAFT module with the inference-only config overrides applied.
 
     Every inference entry point (the validators here, the serving engine
@@ -57,7 +59,19 @@ def make_inference_model(model_cfg: RAFTConfig) -> RAFT:
     ``allpairs_pallas`` impl maps back to ``allpairs`` (10.4 vs 12.0
     frames/s at the Sintel eval shape, whose W/8=128 rows fill the MXU
     lane tile).  Explicit memory-saving choices (``chunked`` /
-    ``pallas``) are respected."""
+    ``pallas``) are respected.
+
+    The per-hardware tuning registry (raft_tpu/tuning.py) is consulted
+    first for ``tuning_kind`` (default 'eval'; the serve engine passes
+    ('serve', 'eval')): knobs left at their RAFTConfig defaults take the
+    autotuned winner for ``(bucket_hw, batch)`` — or the nearest /
+    most-recent entry when the shape isn't known yet, as here where the
+    jit compiles per streamed shape.  The inference overrides above are
+    applied AFTER tuning, so they hold unconditionally."""
+    from raft_tpu import tuning
+
+    model_cfg, _ = tuning.resolve_config(model_cfg, tuning_kind,
+                                         bucket_hw, batch)
     overrides = {"scan_unroll": 1}
     if model_cfg.corr_impl == "allpairs_pallas":
         overrides["corr_impl"] = "allpairs"
@@ -68,7 +82,8 @@ def make_eval_fn(model_cfg: RAFTConfig, iters: int):
     """Jitted ``(variables, image1, image2, flow_init) -> (flow_low,
     flow_up)`` test-mode forward.  ``flow_init`` may be None (traced as a
     static branch via two separate jit entries).  Inference-only config
-    overrides are applied by :func:`make_inference_model`."""
+    overrides (and the tuning-registry consult) are applied by
+    :func:`make_inference_model`."""
     model = make_inference_model(model_cfg)
 
     @jax.jit
@@ -376,3 +391,51 @@ VALIDATORS = {
     "sintel": validate_sintel,
     "kitti": validate_kitti,
 }
+
+
+def evaluate_epe_delta(variables, model_cfg: RAFTConfig, dtypes,
+                       dataset: str = "chairs", iters: int = 24,
+                       batch_size: int = 4, **validator_kwargs) -> Dict:
+    """Same checkpoint, same data, N corr-storage dtypes: the accuracy
+    gate for quantized correlation (the ``scripts/ab_corr_dtype.py``
+    paired methodology promoted into the eval CLI).
+
+    Runs the chosen validator once per dtype in ``dtypes`` — the arms
+    differ ONLY in ``corr_dtype``, everything else (weights, data order,
+    iteration count, padding) is bit-identical — and reports each arm's
+    metrics plus the deltas against the FIRST dtype (the baseline arm;
+    pass 'float32' first to gate against the reference storage).  The
+    acceptance bar for int8 storage is ``|delta| < 0.05`` EPE on the
+    toy/tiny fixtures (asserted in tests/test_corr.py) and a real-data
+    run before any quality-critical deployment (docs/PERFORMANCE.md).
+
+    Returns ``{"dataset", "dtypes", "per_dtype": {dtype: metrics},
+    "delta_vs_<base>": {dtype: {metric: delta}}}``.
+    """
+    from raft_tpu.config import validate_corr_dtype
+
+    dtypes = [validate_corr_dtype(d) for d in dtypes]
+    if len(dtypes) < 2:
+        raise ValueError(f"--epe_delta needs >= 2 dtypes, got {dtypes}")
+    validator = VALIDATORS[dataset]
+    per_dtype: Dict[str, Dict[str, float]] = {}
+    for dt in dtypes:
+        cfg = model_cfg.replace(corr_dtype=dt)
+        print(f"--- corr_dtype={dt} ---", flush=True)
+        per_dtype[dt] = validator(variables, cfg, iters=iters,
+                                  batch_size=batch_size,
+                                  **validator_kwargs)
+    base = dtypes[0]
+    deltas = {
+        dt: {k: round(per_dtype[dt][k] - per_dtype[base][k], 6)
+             for k in per_dtype[base]}
+        for dt in dtypes[1:]
+    }
+    for dt, d in deltas.items():
+        line = ", ".join(f"{k}: {v:+.4f}" for k, v in d.items())
+        print(f"EPE delta {dt} - {base} [{dataset}]: {line}", flush=True)
+    default_sink().emit("eval_epe_delta", dataset=dataset, base=base,
+                        dtypes=list(dtypes),
+                        deltas={dt: d for dt, d in deltas.items()})
+    return {"dataset": dataset, "dtypes": list(dtypes),
+            "per_dtype": per_dtype, f"delta_vs_{base}": deltas}

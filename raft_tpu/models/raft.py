@@ -48,6 +48,7 @@ from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
 from raft_tpu.models.update import (BasicUpdateBlock, MaskHead,
                                     SmallUpdateBlock)
 from raft_tpu.ops.corr import (
+    QuantizedLevel,
     build_corr_pyramid,
     build_corr_pyramid_flat,
     chunked_corr_lookup,
@@ -119,15 +120,27 @@ class RefinementStep(nn.Module):
                                        block_size=cfg.corr_block_size,
                                        precision=cfg.resolved_corr_precision)
         elif corr_impl == "allpairs_pallas":
-            from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup
+            if isinstance(corr_state[0], QuantizedLevel):
+                from raft_tpu.ops.pallas_corr import \
+                    pallas_pyramid_lookup_quantized
 
-            # Taps are consumed in cfg.dtype (the astype below) — emit
-            # them in that dtype from the kernel and skip the fp32
-            # round-trip through HBM (np.dtype is hashable, so it works
-            # as a custom_vjp static arg).
-            corr = pallas_pyramid_lookup(corr_state, coords1,
-                                         cfg.corr_radius,
-                                         cfg.lookup_block_q, None, dt)
+                # Same kernel, int8/fp8 codes on the load path, dequant
+                # fused onto the tap output (linear sampling); no
+                # custom_vjp — the quantize boundary upstream is
+                # stop_gradient'd, so the lookup is primal-only.
+                corr = pallas_pyramid_lookup_quantized(
+                    corr_state, coords1, cfg.corr_radius,
+                    cfg.lookup_block_q, None, dt)
+            else:
+                from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup
+
+                # Taps are consumed in cfg.dtype (the astype below) — emit
+                # them in that dtype from the kernel and skip the fp32
+                # round-trip through HBM (np.dtype is hashable, so it works
+                # as a custom_vjp static arg).
+                corr = pallas_pyramid_lookup(corr_state, coords1,
+                                             cfg.corr_radius,
+                                             cfg.lookup_block_q, None, dt)
         elif corr_impl == "pallas":
             from raft_tpu.ops.pallas_corr import pallas_corr_lookup
 
@@ -307,6 +320,13 @@ class RAFT(nn.Module):
                 pad_q=cfg.lookup_block_q,
                 out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
         elif corr_impl in ("chunked", "pallas"):
+            if cfg.corr_dtype_is_quantized:
+                raise ValueError(
+                    f"corr_dtype={cfg.resolved_corr_dtype!r} requires a "
+                    "materialized pyramid (corr_impl 'allpairs' or "
+                    "'allpairs_pallas'); the on-demand "
+                    f"{corr_impl!r} path never stores the volume, so "
+                    "there is nothing to quantize")
             corr_state = (fmap1, pool_fmap_pyramid(fmap2, cfg.corr_levels))
         else:
             raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")

@@ -53,7 +53,8 @@ class TrainTelemetry:
                  batch_size: int, num_devices: int,
                  image_size: Tuple[int, int],
                  registry: Optional[MetricRegistry] = None,
-                 hbm: Optional[bool] = None):
+                 hbm: Optional[bool] = None,
+                 tuning_stamp: Optional[dict] = None):
         directory = directory or os.environ.get("RAFT_TELEMETRY_DIR") or None
         self.sink = EventSink(directory)
         self.enabled = self.sink.enabled
@@ -61,6 +62,11 @@ class TrainTelemetry:
         self.batch_size = int(batch_size)
         self.num_devices = max(int(num_devices), 1)
         self.image_size = tuple(int(x) for x in image_size)
+        # Tuning-registry provenance (raft_tpu/tuning.py TuningInfo
+        # .stamp()): rides the run_config event so
+        # scripts/telemetry_summary.py can say whether the run's knobs
+        # were autotuned or hand-set.
+        self.tuning_stamp = dict(tuning_stamp or {"tuned": False})
         if hbm is None:
             hbm = os.environ.get("RAFT_TELEMETRY_HBM", "1") == "1"
         self.hbm_enabled = self.enabled and hbm
@@ -118,7 +124,8 @@ class TrainTelemetry:
                        batch_size=self.batch_size,
                        num_devices=self.num_devices,
                        image_size=list(self.image_size),
-                       num_steps=int(num_steps))
+                       num_steps=int(num_steps),
+                       **self.tuning_stamp)
 
     def record_step(self, step: int, step_time_s: float,
                     queue_wait_s: float, h2d_s: float = 0.0,

@@ -41,10 +41,132 @@ We reproduce that exactly; channel layout of the lookup output is
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, NamedTuple, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Quantized pyramid storage (int8 today, fp8 = a dtype swap)
+#
+# The correlation volume is pure *data* between its producer (one einsum)
+# and its consumer (a linear window-sampling pass), so storage can drop
+# below bf16 as long as the sampling re-accumulates fp32: store
+# ``q = round(corr / scale)`` in int8 with a per-level symmetric scale
+# calibrated from the level's correlation row maxima, and because the
+# bilinear window sampling is LINEAR in the stored values, dequantization
+# fuses into the lookup as one multiply on the sampled taps —
+# ``taps_fp32 = sample(q) * scale`` — instead of ever materializing a
+# dequantized volume.  fp8 variants reuse the identical path with a
+# different ``(dtype, qmax)`` pair (no rounding to an integer grid; the
+# cast itself rounds), which is why the spec table below is the ONLY
+# place a new storage dtype has to be added.
+#
+# The quantize boundary is wrapped in stop_gradient: the stored values
+# are integers (no tangent space), so gradients do not flow through the
+# volume to the feature encoder — mirroring the reference's alternate
+# CUDA path, whose backward kernel exists but is never wired
+# (correlation.cpp:51-54).  Quantized storage is therefore an
+# inference/serving optimization first; training with it keeps finite
+# grads everywhere (the context encoder + update block still learn) but
+# freezes fnet's correlation gradient.  See RAFTConfig.corr_dtype.
+# ---------------------------------------------------------------------------
+
+class QuantizedLevel(NamedTuple):
+    """One quantized pyramid level: raw codes + the dequant scale.
+
+    ``values``: same layout as the fp32 level it replaces (either
+    ``(B, N, H, W)`` query-major or ``(B, H, W, Npad)`` query-minor),
+    stored in the quantized dtype.
+    ``scale``: ``(B, 1, 1, 1)`` fp32 per-batch-element dequant scale
+    (symmetric: ``corr ≈ values * scale``), broadcastable against
+    ``values`` in either layout.
+    """
+
+    values: jax.Array
+    scale: jax.Array
+
+
+CorrLevel = Union[jax.Array, QuantizedLevel]
+
+# name -> (jnp dtype, qmax).  qmax is the largest magnitude the code
+# space represents: 127 for int8; the fp8 formats use their finite max
+# (448 for e4m3fn, 57344 for e5m2) so the scale maps the calibrated row
+# maximum onto the top of the representable range.
+_QUANT_SPECS = {
+    "int8": (jnp.int8, 127.0),
+    "float8_e4m3fn": (getattr(jnp, "float8_e4m3fn", None), 448.0),
+    "float8_e5m2": (getattr(jnp, "float8_e5m2", None), 57344.0),
+}
+
+
+def corr_quant_spec(name: str):
+    """``(dtype, qmax)`` for a quantized corr storage dtype name, or
+    ``None`` when ``name`` is a plain (non-quantized) dtype.  Accepts
+    strings, numpy/jnp dtype objects, and dtype classes."""
+    try:
+        name = str(np.dtype(name))   # normalize classes + instances
+    except TypeError:
+        name = str(name)             # 'auto' etc. — not a dtype at all
+    spec = _QUANT_SPECS.get(name)
+    if spec is None:
+        return None
+    dtype, qmax = spec
+    if dtype is None:
+        raise ValueError(
+            f"corr_dtype={name!r} needs jax.numpy.{name} which this "
+            "jax/ml_dtypes build does not provide")
+    return dtype, qmax
+
+
+def quantize_corr_level(corr: jax.Array, spec) -> QuantizedLevel:
+    """Calibrate + quantize one fp32 pyramid level.
+
+    Calibration is the per-level symmetric scale from the row maxima:
+    ``scale = max_rows(max_x |corr_row|) / qmax`` per batch element (the
+    max over rows of per-row maxima == the level max; computed that way
+    so a future per-row scale refinement is a reduction-axis change).
+    Wrapped in stop_gradient — see the module section comment.
+    """
+    dtype, qmax = spec
+    c = jax.lax.stop_gradient(corr.astype(jnp.float32))
+    if corr.size == 0:
+        # Empty (over-pooled) trailing level: nothing to calibrate; the
+        # lookups zero-fill its taps regardless of the scale.
+        return QuantizedLevel(
+            jnp.zeros(corr.shape, dtype),
+            jnp.ones((corr.shape[0], 1, 1, 1), jnp.float32))
+    # Row maxima (max |corr| over the trailing target axes), then the
+    # level max over rows; keepdims so the scale broadcasts in both the
+    # query-major and query-minor layouts.
+    amax = jnp.max(jnp.abs(c), axis=(1, 2, 3), keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / qmax
+    q = c / scale
+    if jnp.issubdtype(dtype, jnp.integer):
+        q = jnp.round(q)
+    q = jnp.clip(q, -qmax, qmax).astype(dtype)
+    return QuantizedLevel(q, scale)
+
+
+def dequantize_level(level: CorrLevel) -> jax.Array:
+    """fp32 view of a pyramid level (tests/debugging; the hot lookups
+    never call this — they fuse the scale into the sampled taps)."""
+    if isinstance(level, QuantizedLevel):
+        return level.values.astype(jnp.float32) * level.scale
+    return level.astype(jnp.float32)
+
+
+def _level_array(level: CorrLevel) -> jax.Array:
+    return level.values if isinstance(level, QuantizedLevel) else level
+
+
+def _store_level(corr: jax.Array, out_dtype, quant_spec) -> CorrLevel:
+    """Round one fp32 level into its storage form (cast or quantize)."""
+    if quant_spec is not None:
+        return quantize_corr_level(corr, quant_spec)
+    return corr.astype(out_dtype)
 
 
 def resolve_precision(precision) -> jax.lax.Precision:
@@ -93,18 +215,20 @@ def _avg_pool_2x2(x: jax.Array) -> jax.Array:
 def build_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array,
                        num_levels: int = 4,
                        precision="highest",
-                       out_dtype=jnp.float32) -> List[jax.Array]:
+                       out_dtype=jnp.float32) -> List[CorrLevel]:
     """Materialized pyramid: level l is ``(B, H1*W1, H/2^l, W/2^l)``.
 
     ``out_dtype``: STORAGE dtype of the levels (``RAFTConfig.corr_dtype``
     semantics, same as :func:`build_corr_pyramid_flat` — pooling math
     stays fp32 and the lookup re-accumulates fp32; only stored values
-    round)."""
+    round).  Quantized names ('int8', fp8) yield
+    :class:`QuantizedLevel` pairs with a per-level calibrated scale."""
+    quant = corr_quant_spec(out_dtype)
     corr = all_pairs_correlation(fmap1, fmap2, precision)
-    pyramid = [corr.astype(out_dtype)]
+    pyramid = [_store_level(corr, out_dtype, quant)]
     for _ in range(num_levels - 1):
         corr = _avg_pool_2x2(corr)
-        pyramid.append(corr.astype(out_dtype))
+        pyramid.append(_store_level(corr, out_dtype, quant))
     return pyramid
 
 
@@ -121,7 +245,7 @@ def _avg_pool_2x2_qminor(x: jax.Array) -> jax.Array:
 def build_corr_pyramid_flat(fmap1: jax.Array, fmap2: jax.Array,
                             num_levels: int = 4, precision="highest",
                             pad_q: int = 128,
-                            out_dtype=jnp.float32) -> List[jax.Array]:
+                            out_dtype=jnp.float32) -> List[CorrLevel]:
     """Materialized pyramid in QUERY-MINOR layout: level l is
     ``(B, H/2^l, W/2^l, Npad)`` with the flattened query dim zero-padded
     to a multiple of ``pad_q``.
@@ -147,10 +271,13 @@ def build_corr_pyramid_flat(fmap1: jax.Array, fmap2: jax.Array,
     corr = corr * (1.0 / float(C) ** 0.5)   # mul, not divide (see above)
     # Pyramid math (pooling) stays fp32; only the STORED levels round to
     # ``out_dtype`` (XLA fuses the casts into the einsum/pool epilogues).
-    pyramid = [corr.astype(out_dtype)]
+    # Quantized storage rides the same seam: the calibration max and the
+    # round-to-code also fuse into the pool epilogue.
+    quant = corr_quant_spec(out_dtype)
+    pyramid = [_store_level(corr, out_dtype, quant)]
     for _ in range(num_levels - 1):
         corr = _avg_pool_2x2_qminor(corr)
-        pyramid.append(corr.astype(out_dtype))
+        pyramid.append(_store_level(corr, out_dtype, quant))
     return pyramid
 
 
@@ -202,12 +329,15 @@ def _sample_windows(corr: jax.Array, coords: jax.Array,
     return taps.reshape(B, N, K * K)
 
 
-def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
+def corr_lookup(pyramid: Sequence[CorrLevel], coords: jax.Array,
                 radius: int, precision="highest") -> jax.Array:
     """Sample the materialized pyramid (reference ``CorrBlock.__call__``).
 
     Args:
-      pyramid: from :func:`build_corr_pyramid`.
+      pyramid: from :func:`build_corr_pyramid`.  Levels may be plain
+        arrays (fp32/bf16 storage) or :class:`QuantizedLevel` pairs —
+        sampling is linear in the stored values, so dequantization is
+        ONE multiply on the sampled taps (never a dequantized volume).
       coords: ``(B, H1, W1, 2)`` target coordinates in level-0 pixel units,
         last axis ``(x, y)``.
 
@@ -218,8 +348,12 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
     c = coords.reshape(B, H1 * W1, 2).astype(jnp.float32)
     outs = []
     for lvl, corr in enumerate(pyramid):
-        outs.append(_sample_windows(corr, c / (2.0 ** lvl), radius,
-                                    precision))
+        taps = _sample_windows(_level_array(corr), c / (2.0 ** lvl),
+                               radius, precision)
+        if isinstance(corr, QuantizedLevel):
+            # Fused dequant: taps are a linear map of the codes.
+            taps = taps * corr.scale.reshape(B, 1, 1)
+        outs.append(taps)
     out = jnp.concatenate(outs, axis=-1)
     return out.reshape(B, H1, W1, -1)
 

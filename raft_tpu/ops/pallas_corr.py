@@ -90,6 +90,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x;
+# accept either so the kernels (and their interpret-mode tests) run on
+# both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 
 # Image rows per inner mat-mul tile; statically unrolled inside, fori_loop
 # across tiles (full unroll over Hl explodes Mosaic compile time, per-row
@@ -443,7 +449,7 @@ def _odm_bwd_blocked_level(lvl, f2, f1p, cpt, gp, k, inv_scale, block_q,
         # Zero rows contribute zero to df1 regardless of tap weights, and
         # the padded df2 rows are sliced away below — no in-kernel masks.
         f2p = jnp.pad(f2p, ((0, 0), (0, Hp - Hl), (0, 0), (0, 0)))
-    vmem = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+    vmem = _CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 
     lkk = gp.shape[1]
     kern1 = functools.partial(_odm_bwd_df1_blocked_kernel, lvl=lvl,
@@ -723,7 +729,7 @@ def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret,
             pltpu.VMEM((k * c.shape[2], block_q), jnp.float32)
             for _, c in nonempty
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(*[c for _, c in nonempty], coords_p)
@@ -775,7 +781,7 @@ def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
                 jax.ShapeDtypeStruct((B, s[1], s[2], Npad), dt)
                 for _, s, dt in grp
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=interpret,
         )(coords_p, g)
@@ -874,6 +880,62 @@ def _pyr_bwd(radius, block_q, interpret, out_dtype, residuals, g):
 pallas_pyramid_lookup.defvjp(_pyr_fwd, _pyr_bwd)
 
 
+def pallas_pyramid_lookup_quantized(pyramid, coords, radius: int = 4,
+                                    block_q: int = 128, interpret=None,
+                                    out_dtype=jnp.float32):
+    """Fused window sampling of a QUANTIZED materialized pyramid.
+
+    ``pyramid``: list of :class:`raft_tpu.ops.corr.QuantizedLevel` in
+    query-minor layout (``values (B, Hl, Wl, Npad)`` int8/fp8 +
+    ``scale (B, 1, 1, 1)`` fp32, from
+    :func:`raft_tpu.ops.corr.build_corr_pyramid_flat` with a quantized
+    ``out_dtype``).  The kernel is the SAME one the fp32/bf16 path runs
+    — the per-tile ``astype(jnp.float32)`` that already rides the VMEM
+    load converts the codes, taps accumulate fp32 in VMEM — and because
+    sampling is linear in the stored values the dequant is one
+    per-level multiply on the (small) tap output, fused by XLA into the
+    kernel epilogue.  An fp8 pyramid is a dtype swap upstream, not a
+    different lookup.
+
+    No ``custom_vjp``: the quantize boundary is stop_gradient'd
+    upstream (codes are integers — no tangent space) and ``coords`` is
+    detached per iteration by the refinement step, so autodiff treats
+    the whole lookup as primal-only — the reference's unwired
+    alt_cuda_corr backward, made explicit.  HBM cost of the resident
+    pyramid drops 4x vs fp32 (2x vs bf16), plus the halved lookup read
+    traffic.
+
+    Returns ``(B, H1, W1, L * (2r+1)^2)`` ``out_dtype`` features.
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    values = [lv.values for lv in pyramid]
+    scales = [lv.scale for lv in pyramid]
+    B, H1, W1, _ = coords.shape
+    N = H1 * W1
+    Npad = values[0].shape[3]
+    if Npad % block_q:
+        raise ValueError(
+            f"pyramid query dim {Npad} is not a multiple of block_q "
+            f"{block_q}; build the pyramid with "
+            f"build_corr_pyramid_flat(..., pad_q={block_q})")
+    k = 2 * radius + 1
+    L = len(values)
+    c = _pad_coords_oor(
+        jax.lax.stop_gradient(coords).reshape(B, N, 2).astype(jnp.float32),
+        Npad).transpose(0, 2, 1)
+    # Accumulate + emit fp32 from the kernel; the per-level dequant
+    # multiply below needs full precision before the consumer cast.
+    out = _pyr_levels_fwd(values, c, radius, block_q, interpret,
+                          jnp.float32)                 # (B, L*k*k, Npad)
+    scale = jnp.concatenate(
+        [s.reshape(B, 1) for s in scales], axis=1)     # (B, L)
+    out = out.reshape(B, L, k * k, Npad) * scale[:, :, None, None]
+    out = out.reshape(B, L * k * k, Npad)[:, :, :N]
+    out = out.reshape(B, L * k * k, H1, W1).transpose(0, 2, 3, 1)
+    return out.astype(out_dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def pallas_corr_lookup(fmap1, fmap2_pyramid, coords, radius: int = 4,
                        block_q: int = 128, interpret=None):
@@ -967,7 +1029,7 @@ def _corr_fwd(fmap1, fmap2_pyramid, coords, radius, block_q, interpret):
             pltpu.VMEM((f2.shape[1] * f2.shape[2], block_q), jnp.float32)
             for _, f2 in nonempty
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(*[f2.astype(f2dt) for _, f2 in nonempty], f1p,
@@ -1041,7 +1103,7 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
                            jnp.float32)
                 for _, f2 in fused
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=interpret,
         )(*[f2.astype(f2dt) for _, f2 in fused], f1p,

@@ -172,10 +172,18 @@ class InferenceEngine:
                  sink: Optional[EventSink] = None):
         # Deferred import: evaluate.py pulls the dataset stack, and the
         # dependency is one function (the shared inference overrides).
+        from raft_tpu import tuning
         from raft_tpu.evaluate import make_inference_model
 
         self.cfg = cfg
-        model = make_inference_model(model_cfg)
+        # Per-hardware tuning registry consult ('serve' entries first,
+        # 'eval' as fallback): one model serves every bucket, so the
+        # lookup is shape-agnostic (nearest/most-recent entry) — the
+        # applied knobs and provenance surface in stats()["tuning"].
+        _, self.tuning_info = tuning.resolve_config(
+            model_cfg, ("serve", "eval"))
+        model = make_inference_model(model_cfg,
+                                     tuning_kind=("serve", "eval"))
         self._fwd = jax.jit(
             lambda v, a, b: model.apply(v, a, b, iters=cfg.iters,
                                         test_mode=True, train=False))
@@ -403,6 +411,11 @@ class InferenceEngine:
         }
         out["num_buckets"] = len(
             {hw for (hw, _) in self.compile_counter.counts()})
+        # Tuning-registry provenance (raft_tpu/tuning.py): which knobs
+        # this replica autotuned, so a fleet operator can tell a tuned
+        # replica from one running hand-rolled defaults.
+        out["tuning"] = dict(self.tuning_info.stamp(),
+                             applied=dict(self.tuning_info.applied))
         return out
 
     # ------------------------------------------------------------------

@@ -1,4 +1,4 @@
-"""bf16 vs fp32 corr-pyramid storage: seed-powered toy A/B.
+"""Corr-pyramid storage dtypes: seed-paired toy A/B (bf16/fp32/int8/...).
 
 VERDICT r3 weak #5 / next #8: the shipped default stores the
 materialized correlation pyramid in bf16 under bf16 compute
@@ -9,11 +9,21 @@ and reports mean +/- sd of the final validation EPE, so the dtype
 effect (if any) is measured against the noise floor instead of under
 it.
 
+``--dtypes`` takes any comma list of corr storage dtypes (e.g.
+``float32,bfloat16,int8``); per-seed runs are PAIRED across arms (same
+seeds, same data, same everything but ``corr_dtype``) and each
+non-baseline arm's paired-gap stats are reported against the baseline
+arm ('float32' when present, else the last listed).  The default pair
+keeps the historical bf16-vs-fp32 comparison and its
+``AB_CORR_DTYPE.json`` key names, so old artifacts resume cleanly.
+
 Toy scale only — real-data full-stage EPE remains the definitive test
-(weights/data-blocked, docs/REAL_WEIGHTS_RUNBOOK.md).
+(weights/data-blocked, docs/REAL_WEIGHTS_RUNBOOK.md); for a pure
+inference gate on a trained checkpoint use
+``python -m raft_tpu evaluate --epe_delta float32,int8`` instead.
 
 Usage: python scripts/ab_corr_dtype.py [--seeds 8] [--steps 300]
-       [--out AB_CORR_DTYPE.json]
+       [--dtypes bfloat16,float32] [--out AB_CORR_DTYPE.json]
 """
 
 from __future__ import annotations
@@ -70,13 +80,32 @@ def run_stage(data_root, workdir, corr_dtype, seed, steps, batch,
 
 
 
+_SHORT = {"bfloat16": "bf16", "float32": "fp32", "float16": "fp16",
+          "int8": "int8", "float8_e4m3fn": "fp8e4m3",
+          "float8_e5m2": "fp8e5m2"}
+
+
+def _short(dtype):
+    return _SHORT.get(dtype, dtype)
+
+
+def _baseline(dtypes):
+    """The arm every other arm is compared against: fp32 (the
+    reference's storage dtype) when present, else the last listed."""
+    return "float32" if "float32" in dtypes else dtypes[-1]
+
+
 def _finalize_stats(results):
-    """Arm means/sds + paired-gap stats from whatever per_seed prefix
-    exists (called after every completed run so a cut-short session
-    still leaves a complete, self-describing artifact)."""
+    """Arm means/sds + per-arm paired-gap stats vs the baseline, from
+    whatever per_seed prefix exists (called after every completed run so
+    a cut-short session still leaves a complete, self-describing
+    artifact).  With the historical default pair the emitted key names
+    (``mean_diff_bf16_minus_fp32`` etc.) are unchanged."""
     import math
 
-    for dtype in ("bfloat16", "float32"):
+    dtypes = results["dtypes"]
+    base = _baseline(dtypes)
+    for dtype in dtypes:
         clean = [e for e in results["per_seed"][dtype] if e is not None]
         results["arms"][dtype] = {
             "n": len(clean),
@@ -84,32 +113,50 @@ def _finalize_stats(results):
             "sd": round(statistics.stdev(clean), 4) if len(clean) > 1
             else None,
         }
-    a, b = results["arms"]["bfloat16"], results["arms"]["float32"]
-    # Paired per-seed differences are the primary readout (the seeds
-    # are matched by construction); the Welch-ish arm gap is kept for
-    # context.
-    pairs = [(x, y) for x, y in zip(results["per_seed"]["bfloat16"],
-                                    results["per_seed"]["float32"])
-             if x is not None and y is not None]
-    if len(pairs) >= 2:
-        diffs = [x - y for x, y in pairs]
-        md = statistics.mean(diffs)
-        sd = statistics.stdev(diffs)
-        se = sd / math.sqrt(len(diffs))
-        results["paired"] = {
-            "n_pairs": len(diffs),
-            "mean_diff_bf16_minus_fp32": round(md, 4),
-            "sd_diff": round(sd, 4),
-            "stderr": round(se, 4),
-            "t": round(md / se, 2) if se else None,
-        }
-    if a["sd"] is not None and b["sd"] is not None:
-        se = math.sqrt((a["sd"] ** 2) / a["n"] + (b["sd"] ** 2) / b["n"])
-        results["mean_gap_bf16_minus_fp32"] = round(
-            a["mean"] - b["mean"], 4)
-        results["gap_stderr"] = round(se, 4)
-        results["gap_in_stderr_units"] = round(
-            (a["mean"] - b["mean"]) / se, 2) if se else None
+    results["baseline"] = base
+    results["paired"] = {}
+    for dtype in dtypes:
+        if dtype == base:
+            continue
+        # Paired per-seed differences are the primary readout (the seeds
+        # are matched by construction); the Welch-ish arm gap below is
+        # kept for context.
+        pairs = [(x, y) for x, y in zip(results["per_seed"][dtype],
+                                        results["per_seed"][base])
+                 if x is not None and y is not None]
+        tag = f"{_short(dtype)}_minus_{_short(base)}"
+        if len(pairs) >= 2:
+            diffs = [x - y for x, y in pairs]
+            md = statistics.mean(diffs)
+            sd = statistics.stdev(diffs)
+            se = sd / math.sqrt(len(diffs))
+            results["paired"][dtype] = {
+                "n_pairs": len(diffs),
+                f"mean_diff_{tag}": round(md, 4),
+                "sd_diff": round(sd, 4),
+                "stderr": round(se, 4),
+                "t": round(md / se, 2) if se else None,
+            }
+        a, b = results["arms"][dtype], results["arms"][base]
+        if a["sd"] is not None and b["sd"] is not None:
+            se = math.sqrt((a["sd"] ** 2) / a["n"]
+                           + (b["sd"] ** 2) / b["n"])
+            results[f"mean_gap_{tag}"] = round(a["mean"] - b["mean"], 4)
+            results[f"gap_stderr_{tag}"] = round(se, 4)
+            results[f"gap_in_stderr_units_{tag}"] = round(
+                (a["mean"] - b["mean"]) / se, 2) if se else None
+    # Historical flat shape for the default bf16-vs-fp32 pair, so
+    # downstream readers of old AB_CORR_DTYPE.json artifacts keep
+    # working unchanged ("paired" flat; un-suffixed gap keys —
+    # mean_gap_bf16_minus_fp32 already matches by construction).
+    if set(dtypes) == {"bfloat16", "float32"}:
+        if "bfloat16" in results["paired"]:
+            results["paired"] = results["paired"]["bfloat16"]
+        if "gap_stderr_bf16_minus_fp32" in results:
+            results["gap_stderr"] = results.pop(
+                "gap_stderr_bf16_minus_fp32")
+            results["gap_in_stderr_units"] = results.pop(
+                "gap_in_stderr_units_bf16_minus_fp32")
 
 
 def main(argv=None):
@@ -123,8 +170,20 @@ def main(argv=None):
                          "elsewhere (the Pallas kernels would run in "
                          "the very slow interpreter off-TPU; both impls "
                          "honor corr_dtype identically)")
+    ap.add_argument("--dtypes", default="bfloat16,float32",
+                    help="comma list of corr storage dtypes to pair "
+                         "(e.g. 'float32,bfloat16,int8'); the baseline "
+                         "arm is float32 when present, else the last")
     ap.add_argument("--out", default="AB_CORR_DTYPE.json")
     args = ap.parse_args(argv)
+
+    from raft_tpu.config import validate_corr_dtype
+
+    args.dtypes = [validate_corr_dtype(d.strip(), flag="--dtypes")
+                   for d in args.dtypes.split(",") if d.strip()]
+    if len(args.dtypes) < 2 or len(set(args.dtypes)) != len(args.dtypes):
+        raise SystemExit(f"--dtypes needs >= 2 distinct dtypes, got "
+                         f"{args.dtypes}")
 
     import jax
 
@@ -143,31 +202,39 @@ def main(argv=None):
     print(f"synthetic chairs in {data_root}", flush=True)
 
     results = {"steps": args.steps, "batch": args.batch,
-               "impl": args.impl, "arms": {},
-               "per_seed": {"bfloat16": [], "float32": []}}
+               "impl": args.impl, "dtypes": list(args.dtypes),
+               "arms": {},
+               "per_seed": {d: [] for d in args.dtypes}}
     # Resume: runs are deterministic given (seed, dtype, params) —
     # verified across processes (the r04 fragment's seed-1000 pair
     # reproduced bit-for-bit in round 5) — so a prior partial artifact
     # with matching parameters seeds the per_seed lists and completed
-    # runs are skipped.
+    # runs are skipped.  Old two-arm artifacts carry no "dtypes" key;
+    # they resume iff the requested arms are the historical pair.
     if osp.exists(args.out):
         try:
             with open(args.out) as f:
                 prev = json.load(f)
         except Exception:
             prev = {}
-        if all(prev.get(k) == results[k]
-               for k in ("steps", "batch", "impl")):
-            for d in ("bfloat16", "float32"):
+        prev_dtypes = prev.get("dtypes",
+                               ["bfloat16", "float32"] if prev else None)
+        if (all(prev.get(k) == results[k]
+                for k in ("steps", "batch", "impl"))
+                and prev_dtypes == results["dtypes"]):
+            for d in args.dtypes:
                 results["per_seed"][d] = list(
                     prev.get("per_seed", {}).get(d, []))
-            print(f"resuming: {len(results['per_seed']['bfloat16'])} "
-                  f"bf16 / {len(results['per_seed']['float32'])} fp32 "
-                  "runs already recorded", flush=True)
+            done = " / ".join(
+                f"{len(results['per_seed'][d])} {_short(d)}"
+                for d in args.dtypes)
+            print(f"resuming: {done} runs already recorded", flush=True)
         elif prev:
             mism = {k: (prev.get(k), results[k])
                     for k in ("steps", "batch", "impl")
                     if prev.get(k) != results[k]}
+            if prev_dtypes != results["dtypes"]:
+                mism["dtypes"] = (prev_dtypes, results["dtypes"])
             print(f"existing {args.out} has different parameters "
                   f"{mism}; starting fresh and OVERWRITING it",
                   flush=True)
@@ -175,7 +242,7 @@ def main(argv=None):
     # seeds still form a paired comparison (arm-major would leave one
     # arm empty).
     for i in range(args.seeds):
-        for dtype in ("bfloat16", "float32"):
+        for dtype in args.dtypes:
             lst = results["per_seed"][dtype]
             if len(lst) > i and lst[i] is not None:
                 continue  # resumed from a prior partial artifact

@@ -71,6 +71,12 @@ def parse_args(argv=None):
                    help="fail when a newest record's "
                         "config.ckpt_fallback_total exceeds this "
                         "(torn-checkpoint gate)")
+    p.add_argument("--require-tuned", action="store_true",
+                   help="fail when a newest record's config lacks "
+                        "`tuned: true` — i.e. its knobs did NOT come "
+                        "from the per-hardware tuning registry "
+                        "(scripts/autotune.py); keeps a BENCH series "
+                        "from silently drifting back to hand-set knobs")
     p.add_argument("--tiny", action="store_true",
                    help="self-test on synthetic series (CPU smoke; "
                         "exercises the pass, drop and nonfinite paths)")
@@ -104,7 +110,7 @@ def build_series(paths):
 
 
 def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
-          max_quarantined=0, max_ckpt_fallback=0):
+          max_quarantined=0, max_ckpt_fallback=0, require_tuned=False):
     """``(failures, report)`` over the newest record of each metric."""
     failures, report = [], []
     for metric, recs in sorted(series.items()):
@@ -113,6 +119,11 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
         cfg = newest.get("config") or {}
         entry = {"metric": metric, "value": value,
                  "path": newest.get("_path"), "n_records": len(recs)}
+        if require_tuned and cfg.get("tuned") is not True:
+            failures.append(
+                f"{metric}: config.tuned is not true — knobs did not "
+                "come from the tuning registry (run scripts/autotune.py "
+                "or drop --require-tuned)")
         nf = cfg.get("nonfinite_steps_total")
         if isinstance(nf, (int, float)) and nf > 0:
             failures.append(
@@ -206,6 +217,13 @@ def _selftest() -> int:
          run([30.0, 31.0, 30.5], last_cfg={"quarantined_total": 0,
                                            "ckpt_fallback_total": 0}),
          False),
+        ("require-tuned fails untuned",
+         run([30.0, 31.0, 30.5], require_tuned=True), True),
+        ("require-tuned passes tuned",
+         run([30.0, 31.0, 30.5], last_cfg={"tuned": True},
+             require_tuned=True), False),
+        ("untuned passes without the gate",
+         run([30.0, 31.0, 30.5], last_cfg={"tuned": False}), False),
     ]
     bad = [name for name, (failures, _), want_fail in cases
            if bool(failures) != want_fail]
@@ -233,7 +251,8 @@ def main(argv=None):
                              window=args.window,
                              min_vs_baseline=args.min_vs_baseline,
                              max_quarantined=args.max_quarantined,
-                             max_ckpt_fallback=args.max_ckpt_fallback)
+                             max_ckpt_fallback=args.max_ckpt_fallback,
+                             require_tuned=args.require_tuned)
     print(json.dumps({"ok": not failures, "failures": failures,
                       "checked": report}))
     if failures:

@@ -137,6 +137,16 @@ def summarize(run_cfg, steps, health=None, faults=None, skip=2):
         health_cfg["ckpt_fallback_total"] = faults.get("ckpt_fallback", 0)
         if faults.get("chaos_inject"):
             health_cfg["chaos_injected_total"] = faults["chaos_inject"]
+    # Tuning-registry provenance (raft_tpu/tuning.py): the run_config
+    # event carries whether the run's knobs came from the autotune
+    # registry (tuned/tuning_key/tuning_registry_hash); fold it through
+    # so summarized runs gate under check_regression --require-tuned
+    # exactly like bench.py records.  Old logs predate the field — they
+    # summarize as untuned.
+    health_cfg["tuned"] = bool(run_cfg.get("tuned", False))
+    for k in ("tuning_key", "tuning_registry_hash", "tuning_fallback"):
+        if k in run_cfg:
+            health_cfg[k] = run_cfg[k]
     last_health = (health or [None])[-1]
     if last_health is not None:
         health_cfg["nonfinite_steps_total"] = last_health.get(

@@ -114,3 +114,234 @@ def test_pyramid_bf16_storage_close_to_fp32():
     assert all(p.dtype == jnp.bfloat16 for p in pyr16)
     got = np.asarray(corr_lookup(pyr16, coords, 4))
     np.testing.assert_allclose(got, want, rtol=0.02, atol=0.05)
+
+
+# ---------------------------------------------------------------------
+# Quantized (int8, fp8-ready) pyramid storage: calibration-scale error
+# bound, gradient semantics, and the end-task EPE gate (ISSUE 6).
+# ---------------------------------------------------------------------
+
+from raft_tpu.ops.corr import (QuantizedLevel, build_corr_pyramid_flat,
+                               corr_quant_spec, dequantize_level,
+                               quantize_corr_level)
+
+
+def _quant_setup(seed=11, B=2, H=16, W=24, C=64):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    f1 = jnp.asarray(rng.standard_normal((B, H, W, C)), jnp.float32)
+    f2 = jnp.asarray(rng.standard_normal((B, H, W, C)), jnp.float32)
+    coords = coords_grid(B, H, W) + jnp.asarray(
+        rng.uniform(-2, 2, (B, H, W, 2)), jnp.float32)
+    return f1, f2, coords
+
+
+def test_int8_pyramid_structure_and_dequant_roundtrip():
+    f1, f2, _ = _quant_setup()
+    pyr = build_corr_pyramid(f1, f2, 4, out_dtype="int8")
+    fp32 = build_corr_pyramid(f1, f2, 4)
+    for q, ref in zip(pyr, fp32):
+        assert isinstance(q, QuantizedLevel)
+        assert q.values.dtype == jnp.int8
+        assert q.scale.shape == (ref.shape[0], 1, 1, 1)
+        # dequant reproduces the level within half a code step
+        err = np.abs(np.asarray(dequantize_level(q)) - np.asarray(ref))
+        bound = 0.5 * np.asarray(q.scale) + 1e-7
+        assert (err <= bound + 1e-6).all()
+
+
+def test_int8_lookup_tracks_fp32_oracle_within_scale_bound():
+    """Max-abs tap error of the int8 path vs the fp32 oracle is bounded
+    by the calibration scale: each stored code is off by <= scale/2 and
+    the bilinear tap weights sum to <= 1 per axis, so every sampled tap
+    inherits the per-level bound."""
+    f1, f2, coords = _quant_setup()
+    want = np.asarray(
+        corr_lookup(build_corr_pyramid(f1, f2, 4), coords, 4))
+    pyr8 = build_corr_pyramid(f1, f2, 4, out_dtype="int8")
+    got = np.asarray(corr_lookup(pyr8, coords, 4))
+    max_scale = max(float(np.asarray(q.scale).max()) for q in pyr8)
+    assert np.abs(got - want).max() <= 0.5 * max_scale * 1.05
+
+
+def test_fp8_is_a_dtype_swap_not_a_new_code_path():
+    """The fp8 variants ride the identical QuantizedLevel plumbing (the
+    design requirement for the fp8 follow-on): same structure, looser
+    error bound (e4m3 keeps 3 mantissa bits)."""
+    pytest.importorskip("jax.numpy", reason="fp8 dtypes need ml_dtypes")
+    if corr_quant_spec("float8_e4m3fn") is None:
+        pytest.skip("no float8_e4m3fn in this jax build")
+    f1, f2, coords = _quant_setup(12)
+    want = np.asarray(
+        corr_lookup(build_corr_pyramid(f1, f2, 4), coords, 4))
+    pyr8 = build_corr_pyramid(f1, f2, 4, out_dtype="float8_e4m3fn")
+    assert all(isinstance(q, QuantizedLevel) for q in pyr8)
+    assert all(q.values.dtype == jnp.float8_e4m3fn for q in pyr8)
+    got = np.asarray(corr_lookup(pyr8, coords, 4))
+    # e4m3 relative step is 2^-3 at the top of each binade; taps are
+    # convex-ish combinations so the worst case stays ~|corr|_max / 8.
+    amax = max(float(np.asarray(q.scale).max()) * 448.0 for q in pyr8)
+    assert np.abs(got - want).max() <= amax / 8.0
+
+
+def test_quantized_lookup_gradients_finite_volume_detached():
+    """Gradient semantics of the quantized path: grads THROUGH the
+    stored volume are zero (the quantize boundary is stop_gradient'd —
+    the reference's unwired alt_cuda_corr backward made explicit), and
+    everything stays finite."""
+    import jax
+
+    f1, f2, coords = _quant_setup(13, B=1, H=8, W=8, C=16)
+
+    def loss(f1j, f2j, c):
+        pyr = build_corr_pyramid(f1j, f2j, 2, out_dtype="int8")
+        return jnp.sum(corr_lookup(pyr, c, 2) ** 2)
+
+    g1, g2, gc = jax.grad(loss, argnums=(0, 1, 2))(f1, f2, coords)
+    for g in (g1, g2, gc):
+        assert np.isfinite(np.asarray(g)).all()
+    # the volume is detached: no gradient reaches the feature maps
+    assert np.abs(np.asarray(g1)).sum() == 0.0
+    assert np.abs(np.asarray(g2)).sum() == 0.0
+
+
+def test_int8_train_step_finite_grads_fnet_frozen():
+    """A full int8 training step runs with finite loss/grads; the
+    documented caveat is pinned: fnet (whose features feed ONLY the
+    quantized volume) gets exactly zero gradient, while cnet + update
+    block still receive signal."""
+    import jax
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.train.loss import sequence_loss  # noqa: F401 (import path)
+    from raft_tpu.train.step import make_loss_fn
+
+    rng = np.random.default_rng(7)
+    cfg = TrainConfig(num_steps=10, batch_size=1, image_size=(48, 64),
+                      iters=2)
+    model = RAFT(RAFTConfig.small_model(corr_impl="allpairs",
+                                        corr_dtype="int8"))
+    img = jnp.zeros((1, 48, 64, 3), jnp.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0),
+                            "dropout": jax.random.PRNGKey(0)},
+                           img, img, iters=2, train=False)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (1, 48, 64, 3)),
+                              jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (1, 48, 64, 3)),
+                              jnp.float32),
+        "flow": jnp.asarray(rng.standard_normal((1, 48, 64, 2)),
+                            jnp.float32),
+        "valid": jnp.ones((1, 48, 64), jnp.float32),
+    }
+    loss_fn = make_loss_fn(model, cfg)
+    (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        variables["params"], variables.get("batch_stats", {}), batch,
+        jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))
+    flat = jax.tree_util.tree_leaves_with_path(grads)
+    fnet_abs = sum(float(jnp.abs(g).sum()) for p, g in flat
+                   if "fnet" in jax.tree_util.keystr(p))
+    other_abs = sum(float(jnp.abs(g).sum()) for p, g in flat
+                    if "fnet" not in jax.tree_util.keystr(p))
+    assert all(np.isfinite(np.asarray(g)).all() for _, g in flat)
+    assert fnet_abs == 0.0
+    assert other_abs > 0.0
+
+
+def test_int8_quantized_rejected_for_ondemand_impls():
+    import jax
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    model = RAFT(RAFTConfig.small_model(corr_impl="chunked",
+                                        corr_dtype="int8"))
+    img = jnp.zeros((1, 48, 64, 3), jnp.float32)
+    with pytest.raises(ValueError, match="materialized"):
+        model.init({"params": jax.random.PRNGKey(0),
+                    "dropout": jax.random.PRNGKey(0)},
+                   img, img, iters=1, train=False)
+
+
+# ---------------------------------------------------------------------
+# The EPE gate (acceptance): same random-init checkpoint, real
+# demo-frames pixels, int8 vs fp32 corr storage -> flow EPE delta
+# < 0.05.  This is the tiny-fixture bar; real-data gating goes through
+# `evaluate.py --epe_delta float32,int8` (docs/PERFORMANCE.md).
+# ---------------------------------------------------------------------
+
+def _demo_frame_pair(hw=(96, 128)):
+    import os.path as osp
+
+    from PIL import Image
+
+    root = osp.join(osp.dirname(osp.dirname(osp.abspath(__file__))),
+                    "demo-frames")
+    h, w = hw
+    ims = []
+    for name in ("frame_0000.png", "frame_0001.png"):
+        arr = np.asarray(Image.open(osp.join(root, name)),
+                         dtype=np.float32)
+        ims.append(arr[:h, :w][None])   # crop keeps real image content
+    return ims
+
+
+def test_int8_epe_gate_on_demo_frames():
+    import jax
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.evaluate import make_eval_fn
+    from raft_tpu.models.raft import RAFT
+
+    im1, im2 = _demo_frame_pair()
+    flows = {}
+    for dt in ("float32", "int8"):
+        cfg = RAFTConfig.small_model(corr_impl="allpairs", corr_dtype=dt)
+        model = RAFT(cfg)
+        variables = model.init({"params": jax.random.PRNGKey(0),
+                                "dropout": jax.random.PRNGKey(0)},
+                               jnp.zeros((1, 48, 64, 3)),
+                               jnp.zeros((1, 48, 64, 3)), iters=1,
+                               train=False)
+        fwd = make_eval_fn(cfg, iters=4)
+        _, up = fwd(variables, jnp.asarray(im1), jnp.asarray(im2))
+        flows[dt] = np.asarray(up)
+    delta = np.sqrt(
+        ((flows["int8"] - flows["float32"]) ** 2).sum(-1)).mean()
+    assert delta < 0.05, f"int8 EPE delta vs fp32 storage: {delta}"
+
+
+def test_evaluate_epe_delta_structure(monkeypatch):
+    """The --epe_delta mode's contract: arms differ only in corr_dtype,
+    deltas are reported against the FIRST dtype, bad inputs fail at the
+    edge."""
+    from raft_tpu import evaluate
+
+    seen = []
+
+    def fake_validator(variables, model_cfg, iters, batch_size, **kw):
+        seen.append(model_cfg.corr_dtype)
+        base = {"float32": 1.0, "int8": 1.02, "bfloat16": 0.99}
+        return {"chairs": base[model_cfg.corr_dtype]}
+
+    monkeypatch.setitem(evaluate.VALIDATORS, "chairs", fake_validator)
+    from raft_tpu.config import RAFTConfig
+
+    out = evaluate.evaluate_epe_delta(
+        {}, RAFTConfig.small_model(), ["float32", "int8", "bfloat16"],
+        dataset="chairs", iters=2, batch_size=1)
+    assert seen == ["float32", "int8", "bfloat16"]
+    assert out["delta_vs_float32"]["int8"]["chairs"] == pytest.approx(
+        0.02)
+    assert out["delta_vs_float32"]["bfloat16"]["chairs"] == pytest.approx(
+        -0.01)
+    with pytest.raises(ValueError, match="allowed"):
+        evaluate.evaluate_epe_delta({}, RAFTConfig.small_model(),
+                                    ["float32", "int4"],
+                                    dataset="chairs")
+    with pytest.raises(ValueError, match=">= 2"):
+        evaluate.evaluate_epe_delta({}, RAFTConfig.small_model(),
+                                    ["float32"], dataset="chairs")

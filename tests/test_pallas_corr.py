@@ -316,3 +316,51 @@ def test_offtpu_fallback_model_runs_without_pallas():
     out_x = RAFT(RAFTConfig.full()).apply(v, img1, img2, iters=2,
                                           test_mode=True)[1]
     np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_x))
+
+
+# ---------------------------------------------------------------------
+# Quantized (int8) materialized-pyramid lookup: the Pallas kernel path.
+# ---------------------------------------------------------------------
+
+def test_quantized_pyramid_lookup_matches_fp32_oracle():
+    """int8 storage through the fused Pallas kernel tracks the fp32 XLA
+    oracle within the calibration-scale bound, and agrees with the XLA
+    int8 path (same codes, same fused dequant) to float tolerance."""
+    from raft_tpu.ops.corr import build_corr_pyramid, build_corr_pyramid_flat
+    from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup_quantized
+
+    f1, f2, coords = _setup(5)
+    want = np.asarray(
+        corr_lookup(build_corr_pyramid(f1, f2, LEVELS), coords, RADIUS))
+    pyr8 = build_corr_pyramid(f1, f2, LEVELS, out_dtype="int8")
+    xla8 = np.asarray(corr_lookup(pyr8, coords, RADIUS))
+    pyrf = build_corr_pyramid_flat(f1, f2, LEVELS, pad_q=128,
+                                   out_dtype="int8")
+    assert all(lv.values.dtype == jnp.int8 for lv in pyrf)
+    got = np.asarray(pallas_pyramid_lookup_quantized(
+        pyrf, coords, RADIUS, 128, True))
+    max_scale = max(float(np.asarray(lv.scale).max()) for lv in pyr8)
+    assert np.abs(got - want).max() <= 0.5 * max_scale * 1.05
+    np.testing.assert_allclose(got, xla8, rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_pyramid_lookup_is_primal_only():
+    """No custom_vjp on the quantized lookup by design: the volume is
+    stop_gradient'd at the quantize boundary and coords are detached, so
+    grads of a loss THROUGH the lookup w.r.t. the feature maps and
+    coords are exactly zero — and tracing them must not error."""
+    from raft_tpu.ops.corr import build_corr_pyramid_flat
+    from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup_quantized
+
+    f1, f2, coords = _setup(6)
+
+    def loss(f1j, f2j, c):
+        pyr = build_corr_pyramid_flat(f1j, f2j, LEVELS, pad_q=128,
+                                      out_dtype="int8")
+        out = pallas_pyramid_lookup_quantized(pyr, c, RADIUS, 128, True)
+        return jnp.sum(out ** 2)
+
+    g1, g2, gc = jax.grad(loss, argnums=(0, 1, 2))(f1, f2, coords)
+    for g in (g1, g2, gc):
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() == 0.0
