@@ -5,6 +5,7 @@ One stable entry point over the ``raft_tpu.cli`` modules (the repo-root
 UX; this is the installed-package spelling)::
 
     python -m raft_tpu train --name raft-chairs --stage chairs ...
+    python -m raft_tpu curriculum --workdir runs/standard ...
     python -m raft_tpu evaluate --model checkpoints/raft-things ...
     python -m raft_tpu demo --model checkpoints/raft-things --path frames/
     python -m raft_tpu serve --model checkpoints/raft-things --port 8080
@@ -18,6 +19,8 @@ import sys
 
 _SUBCOMMANDS = {
     "train": ("raft_tpu.cli.train", "offline training curriculum"),
+    "curriculum": ("raft_tpu.cli.curriculum",
+                   "full multi-stage schedule as ONE resumable job"),
     "evaluate": ("raft_tpu.cli.evaluate", "validation / leaderboard eval"),
     "demo": ("raft_tpu.cli.demo", "flow visualization over a frame dir"),
     "serve": ("raft_tpu.cli.serve", "online HTTP inference server"),

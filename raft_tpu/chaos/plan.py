@@ -41,7 +41,20 @@ fault               seam (point)                 injected error
 ``torn_ckpt``       ``ckpt.save``                files torn post-commit
 ``restore_err``     ``ckpt.restore``             ``InjectedCheckpointCorruption``
 ``device_err``      ``serve.device``             ``InjectedDeviceError``
+``preempt``         ``train.preempt``            cooperative-preemption
+                                                 flag set (SystemExit
+                                                 143 at the boundary)
+``stage_kill``      ``curriculum.stage_boundary``  ``SystemExit(143)``
+                                                 before stage index N
 ==================  ===========================  =======================
+
+``preempt@step=N`` models a SIGTERM landing mid-stage: the train loop
+checks it once per step boundary (step context = global step) and sets
+the same cooperative flag the CLI's real SIGTERM handler sets, so
+kill-and-resume is testable without delivering signals.
+``stage_kill@step=N`` models the SIGTERM landing BETWEEN curriculum
+stages: the driver checks it before starting stage index N (after the
+previous stage's ledger entry committed).
 
 Every fire emits a ``chaos_inject`` JSONL event (default sink) and
 bumps ``raft_chaos_injections_total{fault=...}`` in the default

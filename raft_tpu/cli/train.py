@@ -93,6 +93,13 @@ def parse_args(argv=None):
                         "backward. 0 is faster when its residuals fit "
                         "(+11%% at the things crop batch 8/chip, v5e "
                         "round 3); 1 (default) is the safe choice")
+    p.add_argument("--corr_levels", type=int, default=None,
+                   help="correlation pyramid levels (default: the "
+                        "config's 4).  Toy-scale runs (the curriculum "
+                        "smoke) shrink this to cut CPU compile time")
+    p.add_argument("--corr_radius", type=int, default=None,
+                   help="correlation lookup radius (default: the "
+                        "config's 4)")
     p.add_argument("--scan_unroll", type=int, default=None,
                    help="refinement-scan unroll factor (default: the "
                         "config's tuned 12). Use 1 at beyond-HBM "
@@ -162,6 +169,13 @@ def parse_args(argv=None):
                         "0 = the serial fetch->prep->put->step path "
                         "(A/B; the batch stream is bit-identical "
                         "either way)")
+    p.add_argument("--ckpt_commit_window", "--ckpt-commit-window",
+                   type=int, default=2,
+                   help="bound on in-flight background checkpoint "
+                        "commits: the step loop never waits on "
+                        "checkpoint I/O unless this many saves are "
+                        "still uncommitted (each holds one on-device "
+                        "TrainState snapshot; docs/ROBUSTNESS.md)")
     p.add_argument("--nonfinite_guard", "--nonfinite-guard", type=int,
                    default=1, choices=[0, 1],
                    help="in-graph non-finite step guard: an isfinite "
@@ -239,7 +253,10 @@ def resolve_batch(batch_size, batch_per_chip, num_devices, lr):
     return rounded, lr
 
 
-def main(argv=None):
+def run(argv=None):
+    """Parse flags, build the stage, and train; returns the final
+    :class:`TrainState` (the curriculum driver consumes it — the
+    ``main`` entry below keeps the plain int-returning CLI contract)."""
     args = parse_args(argv)
 
     # Export the telemetry dir before anything builds a default sink, so
@@ -307,8 +324,11 @@ def main(argv=None):
                    remat_policy=args.remat if args.remat != "none"
                    else "save_corr",
                    remat_upsample=bool(args.remat_upsample),
-                   **({"scan_unroll": args.scan_unroll}
-                      if args.scan_unroll is not None else {}))
+                   **{k: v for k, v in
+                      (("scan_unroll", args.scan_unroll),
+                       ("corr_levels", args.corr_levels),
+                       ("corr_radius", args.corr_radius))
+                      if v is not None})
     num_hosts = jax.process_count()
     num_devices = jax.device_count()
     batch_size, lr = resolve_batch(args.batch_size, args.batch_per_chip,
@@ -356,7 +376,8 @@ def main(argv=None):
         forensic_keep=max(args.forensic_keep, 0),
         watchdog_timeout=max(args.watchdog_timeout, 0.0),
         watchdog_exit=args.watchdog_exit,
-        ckpt_dir=args.ckpt_dir)
+        ckpt_dir=args.ckpt_dir,
+        ckpt_commit_window=max(args.ckpt_commit_window, 1))
     dataset = fetch_dataset(args.stage, tuple(args.image_size),
                             root=args.data_root,
                             split_file=args.chairs_split)
@@ -374,16 +395,31 @@ def main(argv=None):
                            num_workers=num_workers,
                            prefetch_batches=args.prefetch_batches)
 
+    from raft_tpu.parallel.mesh import make_mesh
+
+    if args.shard_spatial > 1:
+        mesh = make_mesh(num_data=num_devices // args.shard_spatial,
+                         num_spatial=args.shard_spatial)
+    else:
+        mesh = make_mesh()
+
     restore = None
     if args.restore_ckpt:
         model = RAFT(model_cfg)
         tx = make_optimizer(cfg.lr, cfg.num_steps, cfg.wdecay, cfg.epsilon,
                             cfg.clip)
         template = init_state(model, tx, jax.random.PRNGKey(0), (48, 64))
-        restore = CheckpointManager(args.restore_ckpt).restore_params(
-            template)
+        rmgr = CheckpointManager(args.restore_ckpt)
+        # mesh= reshards the seed weights onto THIS run's topology — a
+        # previous stage trained on a different pod slice seeds cleanly
+        # (docs/ROBUSTNESS.md "Elastic resume").
+        restore = rmgr.restore_params(template, mesh=mesh)
+        saved_on = rmgr.saved_topology(rmgr.latest_step())
+        rmgr.close()
         assert restore is not None, f"no checkpoint in {args.restore_ckpt}"
-        print(f"restored weights from {args.restore_ckpt}", flush=True)
+        print(f"restored weights from {args.restore_ckpt}"
+              + (f" (saved on {saved_on.get('mesh', saved_on)})"
+                 if saved_on else ""), flush=True)
 
     roots = {
         "chairs": dict(root=osp.join(args.data_root,
@@ -403,13 +439,6 @@ def main(argv=None):
             **roots[name])
         for name in args.validation
     }
-
-    mesh = None
-    if args.shard_spatial > 1:
-        from raft_tpu.parallel.mesh import make_mesh
-
-        mesh = make_mesh(num_data=num_devices // args.shard_spatial,
-                         num_spatial=args.shard_spatial)
 
     # Pod preemption (SIGTERM) -> cooperative flag -> the train loop
     # exits at the next STEP BOUNDARY with an emergency checkpoint of
@@ -438,10 +467,18 @@ def main(argv=None):
     install_sigquit_dump(stack_dump_path(
         args.telemetry_dir or os.environ.get("RAFT_TELEMETRY_DIR")))
 
-    train(model_cfg, cfg, loader=loader, validators=validators or None,
-          restore_params=restore, tensorboard_dir=args.tensorboard_dir,
-          profile_dir=args.profile_dir, telemetry_dir=args.telemetry_dir,
-          mesh=mesh, shard_spatial=args.shard_spatial > 1)
+    return train(model_cfg, cfg, loader=loader,
+                 validators=validators or None,
+                 restore_params=restore,
+                 tensorboard_dir=args.tensorboard_dir,
+                 profile_dir=args.profile_dir,
+                 telemetry_dir=args.telemetry_dir,
+                 mesh=mesh, shard_spatial=args.shard_spatial > 1)
+
+
+def main(argv=None):
+    run(argv)
+    return 0
 
 
 if __name__ == "__main__":

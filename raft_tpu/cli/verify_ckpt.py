@@ -48,10 +48,21 @@ def main(argv=None) -> int:
     mgr = CheckpointManager(args.ckpt_dir, async_save=False)
     try:
         reports = mgr.verify_all()
+        topology = mgr.saved_topology()
     finally:
         mgr.close()
     valid = [r["step"] for r in reports if r["ok"]]
     latest_valid = max(valid) if valid else None
+    for r in reports:
+        # Saved-topology stamp (docs/ROBUSTNESS.md "Elastic resume"):
+        # which mesh/device count wrote this step.  Restore reshards
+        # onto the CURRENT topology either way; pre-stamp runs have no
+        # entry.
+        topo = topology.get(str(r["step"]))
+        if topo:
+            r["topology"] = {k: topo[k] for k in
+                             ("mesh", "device_count", "process_count")
+                             if k in topo}
     report = {
         "dir": args.ckpt_dir,
         "steps": reports,
@@ -65,9 +76,20 @@ def main(argv=None) -> int:
             print(f"{args.ckpt_dir}: no saved steps")
         for r in reports:
             status = "ok" if r["ok"] else f"CORRUPT ({r['error']})"
+            topo = r.get("topology")
+            if topo:
+                mesh = topo.get("mesh")
+                status += (f"  [saved on "
+                           + (", ".join(f"{k}={v}"
+                                        for k, v in mesh.items())
+                              if mesh else
+                              f"{topo.get('device_count')} device(s)")
+                           + f", {topo.get('device_count')} device(s)"
+                           f" / {topo.get('process_count')} host(s)]")
             print(f"step {r['step']}: {status}")
         if latest_valid is not None:
-            print(f"resume would restore step {latest_valid}")
+            print(f"resume would restore step {latest_valid} "
+                  "(resharded onto the current topology)")
         else:
             print("resume would FAIL: no restorable checkpoint")
     if report["ok"]:

@@ -400,5 +400,11 @@ class TrainConfig:
     # burning a pod.  Off: dump + event only.
     watchdog_exit: bool = False
     ckpt_dir: str = "checkpoints"
+    # Bound on in-flight background checkpoint commits
+    # (train/checkpoint.py save_async): the step loop never waits on
+    # checkpoint I/O unless this many saves are still uncommitted —
+    # each in-flight commit holds one on-device snapshot of the full
+    # TrainState, so the window is an HBM budget, not a speed knob.
+    ckpt_commit_window: int = 2
     # Number of data-parallel shards (devices); resolved at runtime.
     num_devices: int = 0

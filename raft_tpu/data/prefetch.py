@@ -51,6 +51,14 @@ from raft_tpu.chaos import InjectedProducerCrash
 _ITEM, _END, _ERROR = "item", "end", "error"
 
 
+class PipelineInterrupted(Exception):
+    """Raised out of ``next(pipeline)`` when the consumer's
+    ``interrupt`` predicate turns true while the queue is empty — the
+    cooperative-preemption path out of a blocked input wait
+    (docs/ROBUSTNESS.md).  Not a stream error: the pipeline stays
+    usable, the train loop translates it to its preemption exit."""
+
+
 def _chaos_producer_point(ordinal: int) -> None:
     """`pipeline.producer` injection seam (docs/ROBUSTNESS.md): fires
     the ``producer_err`` fault before batch ``ordinal`` is pulled — on
@@ -88,7 +96,9 @@ class DevicePipeline:
     def __init__(self, batches: Iterable, *,
                  put_fn: Optional[Callable] = None,
                  prep_fn: Optional[Callable] = None,
-                 depth: int = 2, keep_host: bool = False):
+                 depth: int = 2, keep_host: bool = False,
+                 interrupt: Optional[Callable[[], bool]] = None,
+                 interrupt_poll_s: float = 0.1):
         if depth < 0:
             raise ValueError(f"device-prefetch depth must be >= 0, "
                              f"got {depth}")
@@ -103,6 +113,17 @@ class DevicePipeline:
         # the references keeps up to depth+ring batches of host RAM
         # alive that the serial path would have freed.
         self.keep_host = bool(keep_host)
+        # interrupt: optional predicate polled while the consumer waits
+        # on an empty buffer (the SIGTERM fix for the old caveat: a
+        # preemption flag set while ``next()`` was blocked in
+        # ``queue.get`` went unobserved until a batch arrived).  When it
+        # turns true mid-wait, ``next()`` raises
+        # :class:`PipelineInterrupted` instead of blocking on.  Only the
+        # buffered path polls — at depth 0 the consumer is inside the
+        # source iterator itself (host IO), which stays uninterruptible
+        # exactly like the pre-pipeline serial loop.
+        self._interrupt = interrupt
+        self._interrupt_poll_s = max(float(interrupt_poll_s), 1e-3)
         # Per-batch producer spans, valid right after next() returns.
         self.last_prep_s = 0.0
         self.last_h2d_s = 0.0
@@ -181,7 +202,24 @@ class DevicePipeline:
             t2 = time.perf_counter()
             self._account(t1 - t0, t2 - t1)
             return batch
-        kind, payload, host, prep_s, h2d_s = self._q.get()
+        if self._interrupt is None:
+            kind, payload, host, prep_s, h2d_s = self._q.get()
+        else:
+            # Timed wait + flag re-check: a preemption request cannot
+            # interrupt queue.get, so poll.  The poll costs nothing on
+            # the hot path (the queue is non-empty whenever the
+            # producer keeps up) and bounds the observation latency of
+            # a SIGTERM during an input stall to interrupt_poll_s.
+            while True:
+                try:
+                    kind, payload, host, prep_s, h2d_s = self._q.get(
+                        timeout=self._interrupt_poll_s)
+                    break
+                except queue.Empty:
+                    if self._interrupt():
+                        raise PipelineInterrupted(
+                            "preemption requested while waiting on the "
+                            "input pipeline")
         if kind == _END:
             self._closed = True
             raise StopIteration

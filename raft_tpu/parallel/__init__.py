@@ -1,9 +1,11 @@
 """SPMD parallelism: device mesh construction and sharding helpers."""
 
 from raft_tpu.parallel.mesh import (  # noqa: F401
+    abstract_replicated,
     make_mesh,
     batch_sharding,
     make_batch_sharder,
+    mesh_shape,
     replicated_sharding,
     shard_batch,
     spatial_batch_sharding,

@@ -60,6 +60,30 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def mesh_shape(mesh: Mesh) -> Dict[str, int]:
+    """``{'data': N, 'spatial': K}`` — the serializable topology stamp
+    checkpoints record so a restore on a DIFFERENT mesh can report what
+    the run was saved under (docs/ROBUSTNESS.md "Elastic resume")."""
+    return {name: int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def abstract_replicated(tree, mesh: Mesh):
+    """Abstract (shape/dtype/sharding-only) view of ``tree`` with every
+    leaf replicated over ``mesh`` — the reshard-on-restore template.
+
+    Handing orbax an abstract template that CARRIES the target sharding
+    makes the restore place bytes directly onto the new topology,
+    whatever mesh (or device count) the checkpoint was saved under;
+    restoring against concrete arrays instead would pin the layout to
+    the template's (old) placement.  Params/opt_state are replicated in
+    this repo (train/step.py), so ``P()`` everywhere is exact."""
+    sh = replicated_sharding(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=sh),
+        tree)
+
+
 def make_batch_sharder(mesh: Mesh, spatial: bool = False):
     """Build ``put(batch) -> sharded batch``: the host->device placement
     closure with the sharding and the single/multi-host branch resolved

@@ -30,8 +30,9 @@ from typing import Callable, Dict, Optional
 import jax
 import numpy as np
 
+from raft_tpu import chaos
 from raft_tpu.config import RAFTConfig, TrainConfig
-from raft_tpu.data.prefetch import DevicePipeline
+from raft_tpu.data.prefetch import DevicePipeline, PipelineInterrupted
 from raft_tpu.models.raft import RAFT
 from raft_tpu.obs.health import HealthMonitor
 from raft_tpu.obs.train import TrainTelemetry
@@ -183,12 +184,19 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
         loader.registry = telem.registry
 
     ckpt_dir = os.path.join(cfg.ckpt_dir, cfg.name)
-    mgr = CheckpointManager(ckpt_dir,
-                            sink=telem.sink if telem.enabled else None)
-    resumed = mgr.restore_latest(state)
+    mgr = CheckpointManager(
+        ckpt_dir, sink=telem.sink if telem.enabled else None,
+        commit_window=max(int(getattr(cfg, "ckpt_commit_window", 2)), 1))
+    # Elastic resume: restore onto THIS run's mesh whatever topology the
+    # checkpoint was saved under (previous pod slice, different device
+    # count — docs/ROBUSTNESS.md "Elastic resume").
+    resumed = mgr.restore_latest(state, mesh=mesh)
     if resumed is not None:
         state = resumed
-        print(f"resumed from step {int(state.step)}", flush=True)
+        saved_on = mgr.saved_topology(int(state.step)) or {}
+        topo = saved_on.get("mesh", saved_on.get("device_count"))
+        print(f"resumed from step {int(state.step)}"
+              + (f" (saved on {topo})" if topo else ""), flush=True)
 
     step_fn = make_train_step(model, tx, cfg, mesh,
                               shard_spatial=shard_spatial)
@@ -240,7 +248,11 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
         prep_fn=prep_fn,
         depth=max(int(getattr(cfg, "device_prefetch", 0)), 0),
         keep_host=health is not None
-        and getattr(cfg, "forensic_keep", 8) > 0)
+        and getattr(cfg, "forensic_keep", 8) > 0,
+        # Single-host preemption can interrupt an input-stalled consumer
+        # (the pipeline polls the flag while its buffer is empty);
+        # multi-host exits only through the agreed-step sync below.
+        interrupt=_PREEMPT.is_set if jax.process_count() == 1 else None)
     # Stall watchdog: per-iteration heartbeats; no heartbeat within
     # cfg.watchdog_timeout -> all-thread stack dump + `stall` event
     # (+ optional hard exit).  Paused around save/validate, whose
@@ -271,6 +283,12 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                 sharded = next(pipeline)
             except StopIteration:
                 break
+            except PipelineInterrupted:
+                # Preemption observed DURING the input wait (the old
+                # caveat: the flag used to go unseen until a batch
+                # arrived).  State is the last completed step —
+                # consistent, same as the boundary exit below.
+                raise SystemExit(143)
             queue_wait_s = time.perf_counter() - t_iter
             if step >= cfg.num_steps:
                 break
@@ -278,6 +296,14 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                 # Reference append into the forensics ring (the host
                 # copy the pipeline retained) — no transfers, no copies.
                 health.note_batch(step, pipeline.last_host_batch)
+            # `preempt` chaos fault (docs/ROBUSTNESS.md): drive the
+            # cooperative kill-and-resume path deterministically in
+            # tests without delivering real signals.  Single-host only
+            # in effect — the flag it sets is gated below exactly like
+            # the CLI's SIGTERM handler.
+            if chaos.should_inject("preempt", step=step,
+                                   point="train.preempt"):
+                request_preemption()
             if (jax.process_count() == 1 and _PREEMPT.is_set()) or (
                     jax.process_count() > 1
                     and _reached_preemption_sync(step)):
@@ -320,23 +346,27 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                               prep_s=pipeline.last_prep_s)
 
             # Second preemption check before the (potentially minutes-
-            # long) save+validate block, so a SIGTERM during the step
-            # exits here instead of after full validation.  Single-host
-            # only: the per-host flag has no cross-host agreement, so an
+            # long) validate block, so a SIGTERM during the step exits
+            # here instead of after full validation.  Single-host only:
+            # the per-host flag has no cross-host agreement, so an
             # early exit here on one host would strand the others in the
             # collective save/validate block — multi-host preemption
             # exits solely through the agreed-step sync at the top of
-            # the loop.  Caveat: a SIGTERM while the consumer is blocked
-            # on the input pipeline (``next(pipeline)``) is only observed
-            # once a batch arrives — the flag cannot interrupt host-side
-            # IO (the prefetch producer has the same boundary).
+            # the loop.  A SIGTERM while the consumer waits on the input
+            # pipeline is observed within the pipeline's interrupt poll
+            # (PipelineInterrupted above); only a depth-0 pipeline
+            # blocked inside the source iterator itself (host IO)
+            # remains uninterruptible until the batch arrives.
             if jax.process_count() == 1 and _PREEMPT.is_set():
                 raise SystemExit(143)
 
             if step % cfg.val_freq == 0:
                 if watchdog is not None:
                     watchdog.pause()  # save+validate is legitimately slow
-                mgr.save(step, state)
+                # Non-blocking: the committer thread owns the I/O; this
+                # costs one on-device snapshot dispatch (bounded by the
+                # manager's commit window — docs/ROBUSTNESS.md).
+                mgr.save_async(step, state, mesh=mesh)
                 if validators:
                     variables = {"params": state.params}
                     if state.batch_stats:
@@ -353,8 +383,8 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                 if watchdog is not None:
                     watchdog.resume()
 
-        if mgr.latest_step() != int(state.step):
-            mgr.save(int(state.step), state, force=True)
+        if mgr.last_requested_step() != int(state.step):
+            mgr.save(int(state.step), state, force=True, mesh=mesh)
     except (KeyboardInterrupt, SystemExit):
         # Preemption: flush the last COMPLETED step so auto-resume
         # continues exactly where the pod died — optimizer/LR state and
@@ -368,8 +398,16 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
         # registered the step — acceptable for the interactive case.
         print(f"preempted at step {int(state.step)}; checkpointing",
               flush=True)
+        try:
+            # Drain in-flight background commits first so the check
+            # below sees the true newest step (and a committer failure
+            # is reported, not swallowed into the preemption exit).
+            mgr.wait()
+        except Exception as e:
+            print(f"checkpoint flush failed during preemption: {e}",
+                  flush=True)
         if mgr.latest_step() != int(state.step):
-            mgr.save(int(state.step), state, force=True)
+            mgr.save(int(state.step), state, force=True, mesh=mesh)
         raise
     finally:
         if watchdog is not None:

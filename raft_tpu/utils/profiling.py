@@ -287,18 +287,28 @@ def default_compile_cache_dir() -> str:
     return osp.join(tempfile.gettempdir(), f"raft_jaxcache-{ident}")
 
 
-def enable_persistent_compile_cache() -> str:
+def enable_persistent_compile_cache(force: bool = False) -> str:
     """Turn on JAX's persistent XLA compilation cache at one per-user
     location (:func:`default_compile_cache_dir`), created mode 0700.
-    Multi-run harnesses (the corr-dtype A/B, the toy curriculum) build a
-    fresh jit closure per stage, so without this every stage recompiles
-    programs an earlier stage already built — ~40 min/program on the
-    1-core CPU fallback, ~20-40 s each on TPU.  Returns the cache
-    directory."""
+    Multi-run harnesses (the corr-dtype A/B, the curriculum driver)
+    build a fresh jit closure per stage, so without this every stage
+    recompiles programs an earlier stage already built — ~40
+    min/program on the 1-core CPU fallback, ~20-40 s each on TPU.
+    Returns the cache directory ("" when skipped).
+
+    No-op on the CPU backend unless ``force``: on this jaxlib,
+    deserializing a cached XLA:CPU train-step executable aborts the
+    process (glibc "corrupted double-linked list" / "futex facility
+    returned an unexpected error code" on the first execution) —
+    reproduced deterministically by running the same stage twice in one
+    process with the cache on, and gone with it off.  TPU/GPU
+    deserialization is the supported, tested path."""
     import os
 
     import jax
 
+    if jax.default_backend() == "cpu" and not force:
+        return ""
     cache_dir = default_compile_cache_dir()
     os.makedirs(cache_dir, mode=0o700, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -7,7 +7,9 @@ the reference's exact directory layouts (SURVEY.md C8), then drive the
 REAL ``raft_tpu.cli.train`` through the complete
 chairs -> things -> sintel -> kitti curriculum — the
 ``scripts/train_standard.sh`` shape (reference train_standard.sh:3-6) at
-toy scale — with validators on and stages chained via ``--restore_ckpt``.
+toy scale — via the resumable curriculum driver
+(``raft_tpu/curriculum.py``: stage ledger on disk, weights-only seeding
+between stages, kill-anywhere resume by re-running the same command).
 The validator EPE trajectory is written to a JSON ledger.
 
 Scenes are rigid translations of smooth random textures (exactly
@@ -300,66 +302,56 @@ def main(argv=None):
     # The four stages (and validators) each build fresh jit closures;
     # the shared persistent cache keeps later stages (and the A/B
     # harness, which drives the same programs) from recompiling them.
+    # (No-op on the CPU backend, where cached executables are unsafe —
+    # see enable_persistent_compile_cache.)
     enable_persistent_compile_cache()
 
     data_root = build_corpora(workdir)
     print(f"synthetic corpora in {data_root}", flush=True)
 
-    from raft_tpu.cli import train as train_cli
+    # The schedule rides the resumable curriculum driver
+    # (raft_tpu/curriculum.py): stage chaining, the on-disk stage
+    # ledger, and validator-output capture are its job now — killing
+    # this script anywhere and re-running the same command resumes
+    # where it stopped instead of restarting stage 1.
+    from raft_tpu.curriculum import (LEDGER_FILE, Manifest, StageLedger,
+                                     StageSpec, run_curriculum)
 
+    manifest = Manifest(
+        base={"num_steps": args.steps, "batch_per_chip": args.batch,
+              "image_size": list(CROP), "iters": 8,
+              "val_freq": args.steps,  # validate at stage end
+              "data_root": data_root,
+              "chairs_split": osp.join(workdir, "chairs_split.txt"),
+              "ckpt_dir": osp.join(workdir, "ckpts")},
+        stages=[StageSpec(f"toy-{stage}", stage,
+                          {"validation": list(validation)})
+                for stage, validation in STAGES])
+    run_curriculum(manifest, workdir)
+
+    # Fold the driver's stage ledger (validator lines included) into
+    # this script's historical evidence format, then apply the
+    # discriminative checks over every stage at once.
+    stage_ledger = StageLedger(osp.join(workdir, LEDGER_FILE))
+    stage_ledger.load()
     ledger = {"steps_per_stage": args.steps, "stages": []}
-    prev_ckpt = None
-    for stage, validation in STAGES:
-        name = f"toy-{stage}"
-        cli = [
-            "--name", name, "--stage", stage,
-            "--num_steps", str(args.steps),
-            "--batch_per_chip", str(args.batch),
-            "--image_size", str(CROP[0]), str(CROP[1]),
-            "--iters", "8",
-            "--val_freq", str(args.steps),  # validate at stage end
-            "--data_root", data_root,
-            "--chairs_split", osp.join(workdir, "chairs_split.txt"),
-            "--ckpt_dir", osp.join(workdir, "ckpts"),
-            "--validation", *validation,
-        ]
-        if prev_ckpt:
-            cli += ["--restore_ckpt", prev_ckpt]
-        print(f"=== stage {stage}: train {cli}", flush=True)
-
-        import io
-        from contextlib import redirect_stdout
-
-        buf = io.StringIO()
-
-        class Tee(io.TextIOBase):
-            def write(self, s):
-                buf.write(s)
-                sys.__stdout__.write(s)
-                return len(s)
-
-            def flush(self):
-                sys.__stdout__.flush()
-
-        with redirect_stdout(Tee()):
-            train_cli.main(cli)
-        out = buf.getvalue()
-        epes = {}
-        for line in out.splitlines():
-            if line.startswith("Validation"):
-                epes.setdefault("lines", []).append(line.strip())
-        epes.update(_parse_validation(out))
+    failed_stages = []
+    for stage, _ in STAGES:
+        entry = stage_ledger.stage(f"toy-{stage}")
+        lines = entry.get("validation", [])
+        epes = {"lines": lines} if lines else {}
+        epes.update(_parse_validation("\n".join(lines)))
         checks = _discriminative_checks(stage, epes)
         ledger["stages"].append({"stage": stage, "validators": epes,
                                  "checks": checks})
         failed = [k for k, v in checks.items() if v is False]
-        if failed:  # write the evidence BEFORE failing the run
-            ledger["failed_stage"] = {"stage": stage, "failed": failed}
-            _write_ledger(args, workdir, ledger)
-            raise AssertionError(
-                f"stage {stage}: discriminative checks failed: {failed} "
-                f"({epes})")
-        prev_ckpt = osp.join(workdir, "ckpts", name)
+        if failed:
+            failed_stages.append({"stage": stage, "failed": failed})
+    if failed_stages:  # write the evidence BEFORE failing the run
+        ledger["failed_stage"] = failed_stages[0]
+        _write_ledger(args, workdir, ledger)
+        raise AssertionError(
+            f"discriminative checks failed: {failed_stages}")
 
     _write_ledger(args, workdir, ledger)
 

@@ -407,11 +407,27 @@ def test_structure_mismatch_narrowing():
     yes = [ValueError("User-provided restore item and on-disk value "
                       "metadata tree structures do not match"),
            ValueError("Tree structure mismatch at key nonfinite_steps"),
-           KeyError("nonfinite_steps")]
+           KeyError("nonfinite_steps"),
+           # "missing" + the legacy-counter signature stays a mismatch:
+           # the nonfinite_steps wording always wins over the veto
+           ValueError("restore template missing key nonfinite_steps")]
     no = [json.JSONDecodeError("Unterminated string", "x", 0),
           OSError("read failed"),
           RuntimeError("structure"),  # wrong class, right word
-          ValueError("bad .flo magic")]
+          ValueError("bad .flo magic"),
+          # Regression (PR 7): torn-file IO errors phrased with
+          # "missing" — tensorstore/orbax wording for truncated or
+          # absent chunk files — must classify as CORRUPTION, never as
+          # a structure mismatch (the legacy-template retry would bury
+          # the real traceback).
+          ValueError('NOT_FOUND: Error opening "zarr" driver: '
+                     'Metadata at "params/w/.zarray" does not exist'),
+          ValueError('Error opening "zarr" driver: missing chunk 0.0 '
+                     'for "opt_state/mu/w"'),
+          ValueError("missing metadata file for array params/b"),
+          KeyError("manifest.ocdbt truncated: missing data"),
+          TypeError("CHECKSUM mismatch decoding params/w: missing "
+                    "trailing bytes")]
     assert all(_is_structure_mismatch(e) for e in yes)
     assert not any(_is_structure_mismatch(e) for e in no)
 

@@ -1,0 +1,266 @@
+"""Curriculum driver tests (tier-1): manifest schema + paper schedule,
+argv building, the on-disk stage ledger's resume/refusal semantics,
+`run_curriculum` resume behavior (stub train runner — no jit), the
+``stage_kill`` chaos seam, the CLI, and the end-to-end
+``curriculum_smoke --tiny`` acceptance run (real training: two
+micro-stages chaos-killed mid-stage and at the stage boundary, resumed
+to completion with exact telemetry counts)."""
+
+import importlib.util
+import json
+import os.path as osp
+
+import pytest
+
+from raft_tpu import chaos
+from raft_tpu.chaos import FaultPlan
+from raft_tpu.curriculum import (LEDGER_FILE, Manifest, StageLedger,
+                                 StageSpec, argv_from_overrides,
+                                 run_curriculum)
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, osp.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+class _FakeState:
+    def __init__(self, step):
+        self.step = step
+
+
+def _stub_runner(log, final_step=7, die_on=None):
+    """argv -> _FakeState; records every call.  ``die_on``: stage name
+    whose run raises SystemExit(143) (cooperative preemption) — once
+    per name, via the mutable set."""
+    dead = set()
+
+    def run(argv):
+        log.append(list(argv))
+        name = argv[argv.index("--name") + 1]
+        if die_on and name == die_on and name not in dead:
+            dead.add(name)
+            print(f"preempted in {name}")
+            raise SystemExit(143)
+        print(f"Validation ({name}) epe: 0.5")
+        return _FakeState(final_step)
+
+    return run
+
+
+def _manifest():
+    return Manifest(
+        base={"iters": 2, "num_steps": 7},
+        stages=[StageSpec("s1", "chairs", {"lr": 1e-3}),
+                StageSpec("s2", "things", {"small": True})])
+
+
+# ---------------------------------------------------------------------
+# manifest + argv building
+# ---------------------------------------------------------------------
+
+def test_manifest_standard_matches_paper():
+    """The reference train_standard.sh schedule, as data."""
+    m = Manifest.standard()
+    assert [(s.name, s.stage) for s in m.stages] == [
+        ("raft-chairs", "chairs"), ("raft-things", "things"),
+        ("raft-sintel", "sintel"), ("raft-kitti", "kitti")]
+    o = {s.stage: s.overrides for s in m.stages}
+    assert [o[s]["num_steps"] for s in
+            ("chairs", "things", "sintel", "kitti")] == [
+        100000, 100000, 100000, 50000]
+    assert [o[s]["batch_size"] for s in
+            ("chairs", "things", "sintel", "kitti")] == [10, 6, 6, 6]
+    assert o["chairs"]["lr"] == 4e-4 and o["kitti"]["lr"] == 1e-4
+    assert o["chairs"]["wdecay"] == 1e-4 and o["sintel"]["wdecay"] == 1e-5
+    assert o["sintel"]["gamma"] == 0.85 and "gamma" not in o["chairs"]
+    assert o["kitti"]["image_size"] == [288, 960]
+    # round-trips through its own JSON form
+    assert Manifest.from_dict(m.to_dict()).fingerprint() == m.fingerprint()
+
+
+def test_manifest_validation_and_fingerprint():
+    with pytest.raises(ValueError, match="no stages"):
+        Manifest.from_dict({"stages": []})
+    with pytest.raises(ValueError, match="duplicate stage names"):
+        Manifest.from_dict({"stages": [
+            {"name": "a", "stage": "chairs"},
+            {"name": "a", "stage": "things"}]})
+    m1, m2 = _manifest(), _manifest()
+    assert m1.fingerprint() == m2.fingerprint()
+    m2.stages[0].overrides["lr"] = 9e-9
+    assert m1.fingerprint() != m2.fingerprint()
+
+
+def test_argv_from_overrides():
+    argv = argv_from_overrides({
+        "small": True, "mixed_precision": False, "restore_ckpt": None,
+        "image_size": [368, 496], "validation": ("chairs", "sintel"),
+        "lr": 4e-4, "num_steps": 100000})
+    assert argv == ["--small", "--image_size", "368", "496",
+                    "--validation", "chairs", "sintel",
+                    "--lr", "0.0004", "--num_steps", "100000"]
+
+
+# ---------------------------------------------------------------------
+# stage ledger
+# ---------------------------------------------------------------------
+
+def test_ledger_begin_update_normalize(tmp_path):
+    led = StageLedger(str(tmp_path / LEDGER_FILE))
+    led.begin(_manifest())
+    assert osp.exists(led.path)
+    assert not osp.exists(led.path + ".tmp")  # atomic tmp+rename
+    led.update("s1", status="complete", final_step=7)
+    # a fresh load sees the committed transition
+    led2 = StageLedger(led.path)
+    led2.load()
+    assert led2.normalized() == {
+        "status": "running",
+        "stages": {"s1": {"status": "complete", "final_step": 7},
+                   "s2": {"status": "pending", "final_step": None}}}
+
+
+def test_ledger_refuses_changed_manifest(tmp_path):
+    led = StageLedger(str(tmp_path / LEDGER_FILE))
+    led.begin(_manifest())
+    changed = _manifest()
+    changed.stages[1].overrides["lr"] = 5e-4
+    with pytest.raises(ValueError, match="CHANGED schedule"):
+        StageLedger(led.path).begin(changed)
+    # the SAME manifest resumes fine
+    StageLedger(led.path).begin(_manifest())
+
+
+# ---------------------------------------------------------------------
+# run_curriculum: fresh run, skip-complete, seeding, resume
+# ---------------------------------------------------------------------
+
+def test_run_curriculum_fresh_then_noop_rerun(tmp_path):
+    wd = str(tmp_path / "wd")
+    log = []
+    state = run_curriculum(_manifest(), wd, extra_argv=["--seed", "3"],
+                           train_runner=_stub_runner(log))
+    assert state["status"] == "complete"
+    assert len(log) == 2
+    a1, a2 = log
+    # base + overrides + extra flags, ckpt root pinned under workdir
+    assert a1[:6] == ["--name", "s1", "--stage", "chairs",
+                      "--ckpt_dir", osp.join(wd, "checkpoints")]
+    assert a1[-2:] == ["--seed", "3"]
+    assert "--lr" in a1 and "--small" not in a1
+    assert "--small" in a2
+    # weights-only seed from the previous stage's checkpoint dir
+    assert a2[-2:] == ["--restore_ckpt",
+                       osp.join(wd, "checkpoints", "s1")]
+    # first stage has no seed
+    assert "--restore_ckpt" not in a1
+
+    led = StageLedger(osp.join(wd, LEDGER_FILE))
+    led.load()
+    for name in ("s1", "s2"):
+        e = led.stage(name)
+        assert e["status"] == "complete" and e["final_step"] == 7
+        assert e["runs"] == 1
+        assert e["validation"] == [f"Validation ({name}) epe: 0.5"]
+
+    # re-running the SAME command is a no-op: every stage skipped
+    run_curriculum(_manifest(), wd, train_runner=_stub_runner(log))
+    assert len(log) == 2
+
+
+def test_run_curriculum_resumes_mid_stage_kill(tmp_path):
+    """A SystemExit out of stage 2 (cooperative preemption) leaves the
+    ledger marking it ``running``; re-invoking re-enters exactly that
+    stage, and the final normalized ledger matches an uninterrupted
+    run's — the kill-point-independence acceptance check."""
+    wd, wd_ref = str(tmp_path / "wd"), str(tmp_path / "ref")
+    ref_log = []
+    ref = run_curriculum(_manifest(), wd_ref,
+                         train_runner=_stub_runner(ref_log))
+
+    log = []
+    with pytest.raises(SystemExit) as ei:
+        run_curriculum(_manifest(), wd,
+                       train_runner=_stub_runner(log, die_on="s2"))
+    assert ei.value.code == 143
+    led = StageLedger(osp.join(wd, LEDGER_FILE))
+    led.load()
+    assert led.stage("s1")["status"] == "complete"
+    assert led.stage("s2")["status"] == "running"
+    assert led.state["status"] == "running"
+
+    state = run_curriculum(_manifest(), wd,
+                           train_runner=_stub_runner(log))
+    assert [a[a.index("--name") + 1] for a in log] == ["s1", "s2", "s2"]
+    led.load()
+    assert led.stage("s2")["runs"] == 2
+    assert state["status"] == "complete"
+    # normalized views converge regardless of the kill
+    ref_led = StageLedger(osp.join(wd_ref, LEDGER_FILE))
+    ref_led.load()
+    assert ref["status"] == "complete"
+    assert led.normalized() == ref_led.normalized() == {
+        "status": "complete",
+        "stages": {"s1": {"status": "complete", "final_step": 7},
+                   "s2": {"status": "complete", "final_step": 7}}}
+
+
+def test_stage_kill_chaos_fires_at_boundary(tmp_path):
+    """The ``stage_kill`` fault kills BETWEEN stages — after s1's
+    ledger commit, before s2 starts — and a resume skips s1 without
+    re-arming the seam."""
+    wd = str(tmp_path / "wd")
+    log = []
+    chaos.install(FaultPlan.parse("stage_kill@step=1"))
+    with pytest.raises(SystemExit) as ei:
+        run_curriculum(_manifest(), wd, train_runner=_stub_runner(log))
+    assert ei.value.code == 143
+    led = StageLedger(osp.join(wd, LEDGER_FILE))
+    led.load()
+    assert led.stage("s1")["status"] == "complete"
+    assert led.stage("s2")["status"] == "pending"  # never started
+    assert chaos.active().counts()["stage_kill"] == 1
+
+    chaos.uninstall()
+    run_curriculum(_manifest(), wd, train_runner=_stub_runner(log))
+    assert [a[a.index("--name") + 1] for a in log] == ["s1", "s2"]
+
+
+def test_curriculum_cli_dump_manifest(tmp_path, capsys):
+    from raft_tpu.cli.curriculum import main as cli_main
+
+    assert cli_main(["--dump-manifest"]) == 0
+    dumped = json.loads(capsys.readouterr().out)
+    assert Manifest.from_dict(dumped).fingerprint() == \
+        Manifest.standard().fingerprint()
+
+    with pytest.raises(SystemExit, match="--workdir is required"):
+        cli_main([])
+
+
+# ---------------------------------------------------------------------
+# curriculum_smoke: the end-to-end acceptance criterion (real training;
+# preempt + torn ckpt mid-stage, stage_kill at the boundary, resume to
+# an identical normalized ledger; exact chaos/fallback/commit counts)
+# ---------------------------------------------------------------------
+
+def test_curriculum_smoke_tiny(capsys):
+    mod = _load_script("curriculum_smoke")
+    rc = mod.main(["--tiny"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, rec
+    assert rec["metric"] == "curriculum_smoke" and rec["value"] == 1.0
+    assert not chaos.enabled()  # the script cleans up after itself

@@ -1,0 +1,332 @@
+"""Elastic-resume layer tests (tier-1): reshard-on-restore across mesh
+shapes, the non-blocking background-commit path with its ``ckpt_commit``
+telemetry, the run-level topology ledger, and the verify-ckpt topology
+report.
+
+The contracts pinned here are the PR-7 acceptance criteria: a checkpoint
+saved under ANY mesh shape restores bit-exactly onto any other (device
+count included); ``save_async`` never loses a save and surfaces a dying
+committer loudly; the topology stamp survives torn step directories and
+is reported by ``verify-ckpt``.
+
+Everything runs on the suite's 8 virtual CPU devices (conftest.py) with
+a tiny 2x2-param TrainState — no model code, no jit of real programs.
+"""
+
+import json
+import os.path as osp
+
+import numpy as np
+import pytest
+
+from raft_tpu import chaos
+from raft_tpu.obs import EventSink
+from raft_tpu.parallel.mesh import (abstract_replicated, make_mesh,
+                                    mesh_shape, replicated_sharding)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _events(path):
+    import os
+
+    out = []
+    for fname in sorted(os.listdir(path)):
+        if fname.endswith(".jsonl"):
+            with open(osp.join(path, fname)) as f:
+                out += [json.loads(ln) for ln in f if ln.strip()]
+    return out
+
+
+def _state(step=0, mesh=None):
+    """Tiny TrainState with REAL optimizer moments (adam), so the
+    round-trip checks opt_state bytes, not just params."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from raft_tpu.train.state import TrainState
+
+    params = {"w": jnp.arange(4, dtype=jnp.float32).reshape(2, 2)
+              + float(step),
+              "b": jnp.full((3,), 0.5 + step, jnp.float32)}
+    tx = optax.adam(1e-3)
+    st = TrainState(step=jnp.asarray(step, jnp.int32), params=params,
+                    batch_stats={}, opt_state=tx.init(params),
+                    nonfinite_steps=jnp.zeros((), jnp.int32))
+    if mesh is not None:
+        sh = replicated_sharding(mesh)
+        st = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), st)
+    return st
+
+
+def _mgr(path, sink=None, **kw):
+    from raft_tpu.train.checkpoint import CheckpointManager
+
+    kw.setdefault("async_save", False)
+    return CheckpointManager(str(path), sink=sink, **kw)
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _assert_bit_exact(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.asarray(x).dtype == np.asarray(y).dtype
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------
+# reshard-on-restore: the tentpole acceptance matrix
+# ---------------------------------------------------------------------
+
+def _mesh_matrix():
+    """CPU-fakable mesh shapes over the suite's 8 virtual devices:
+    full-DP, a 4-device subset (simulated smaller slice), and two
+    2-D (data, spatial) splits — the elastic-resume acceptance set."""
+    import jax
+
+    devs = jax.devices()
+    return [
+        ("data8", make_mesh(num_data=8)),
+        ("data4-subset", make_mesh(num_data=4, devices=devs[:4])),
+        ("data2-spatial2", make_mesh(num_data=2, num_spatial=2,
+                                     devices=devs[:4])),
+        ("data4-spatial2", make_mesh(num_data=4, num_spatial=2)),
+    ]
+
+
+def test_reshard_restore_matrix_bit_exact(tmp_path):
+    """Save under every mesh in the matrix; restore under every OTHER
+    mesh; params + opt_state + counters restore bit-exactly and land
+    replicated on the TARGET mesh."""
+    meshes = _mesh_matrix()
+    for save_name, save_mesh in meshes:
+        ck = tmp_path / f"ck-{save_name}"
+        src = _state(step=3, mesh=save_mesh)
+        mgr = _mgr(ck)
+        mgr.save(3, src, mesh=save_mesh)
+        mgr.wait()
+        mgr.close()
+        for tgt_name, tgt_mesh in meshes:
+            if tgt_name == save_name:
+                continue
+            rmgr = _mgr(ck)
+            st = rmgr.restore_latest(_state(0), mesh=tgt_mesh)
+            rmgr.close()
+            _assert_bit_exact(st, src)
+            # every leaf replicated on the TARGET mesh's devices
+            for leaf in _leaves(st):
+                sh = leaf.sharding
+                assert set(sh.device_set) == set(
+                    tgt_mesh.devices.flat), (save_name, tgt_name)
+                assert sh.is_fully_replicated
+
+
+def test_reshard_restore_params_weights_only(tmp_path):
+    """The curriculum stage-seed path: ``restore_params`` reshards the
+    weights(+batch_stats) onto the target mesh and drops opt_state."""
+    import jax
+
+    save_mesh = make_mesh(num_data=8)
+    tgt_mesh = make_mesh(num_data=2, num_spatial=2,
+                         devices=jax.devices()[:4])
+    src = _state(step=5, mesh=save_mesh)
+    mgr = _mgr(tmp_path / "ck")
+    mgr.save(5, src, mesh=save_mesh)
+    mgr.wait()
+    got = mgr.restore_params(_state(0), mesh=tgt_mesh)
+    mgr.close()
+    assert set(got) == {"params", "batch_stats"}
+    _assert_bit_exact(got["params"], src.params)
+    for leaf in _leaves(got["params"]):
+        assert set(leaf.sharding.device_set) == set(tgt_mesh.devices.flat)
+
+
+def test_abstract_replicated_template():
+    """The reshard template: shape/dtype preserved, every leaf abstract
+    with replicated sharding on the given mesh."""
+    import jax
+
+    mesh = make_mesh(num_data=4, num_spatial=2)
+    tree = {"w": np.zeros((2, 3), np.float32),
+            "n": np.zeros((), np.int32)}
+    abs_tree = abstract_replicated(tree, mesh)
+    assert abs_tree["w"].shape == (2, 3)
+    assert abs_tree["w"].dtype == np.float32
+    assert abs_tree["n"].shape == ()
+    for leaf in jax.tree_util.tree_leaves(abs_tree):
+        assert isinstance(leaf, jax.ShapeDtypeStruct)
+        assert leaf.sharding == replicated_sharding(mesh)
+
+
+# ---------------------------------------------------------------------
+# topology stamp ledger
+# ---------------------------------------------------------------------
+
+def test_topology_stamp_recorded_and_survives_torn_step(tmp_path):
+    import jax
+
+    mesh = make_mesh(num_data=4, num_spatial=2)
+    mgr = _mgr(tmp_path / "ck", sink=EventSink(None))
+    mgr.save(1, _state(1), mesh=mesh)
+    mgr.save(2, _state(2), mesh=mesh)
+    mgr.wait()
+
+    topo = mgr.saved_topology()
+    assert set(topo) == {"1", "2"}
+    for entry in topo.values():
+        assert entry["mesh"] == {"data": 4, "spatial": 2}
+        assert entry["device_count"] == jax.device_count()
+        assert entry["process_count"] == 1
+    assert mgr.saved_topology(2)["mesh"] == {"data": 4, "spatial": 2}
+    assert mgr.saved_topology(99) is None
+
+    # The ledger is a SIBLING of the step dirs: tearing a step cannot
+    # take the stamps with it.
+    chaos.tear_files(str(tmp_path / "ck" / "2"))
+    assert mgr.saved_topology(2)["mesh"] == {"data": 4, "spatial": 2}
+    mgr.close()
+
+    # save without a mesh: stamped, but no mesh key (device_count only)
+    mgr2 = _mgr(tmp_path / "ck2")
+    mgr2.save(7, _state(7))
+    mgr2.wait()
+    ent = mgr2.saved_topology(7)
+    assert "mesh" not in ent and ent["device_count"] == jax.device_count()
+    mgr2.close()
+
+
+def test_verify_ckpt_reports_topology(tmp_path, capsys):
+    from raft_tpu.cli.verify_ckpt import main as verify_main
+
+    mesh = make_mesh(num_data=8)
+    mgr = _mgr(tmp_path / "ck", sink=EventSink(None))
+    mgr.save(1, _state(1), mesh=mesh)
+    mgr.wait()
+    mgr.close()
+
+    assert verify_main([str(tmp_path / "ck"), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out.strip())
+    (step_rep,) = rep["steps"]
+    assert step_rep["ok"] is True
+    assert step_rep["topology"]["mesh"] == {"data": 8, "spatial": 1}
+    assert step_rep["topology"]["device_count"] == 8
+
+    # text mode mentions the saved topology
+    assert verify_main([str(tmp_path / "ck")]) == 0
+    out = capsys.readouterr().out
+    assert "data=8" in out and "spatial=1" in out
+
+
+# ---------------------------------------------------------------------
+# non-blocking background commits
+# ---------------------------------------------------------------------
+
+def test_save_async_commits_and_emits_events(tmp_path):
+    tdir = tmp_path / "telemetry"
+    mesh = make_mesh(num_data=8)
+    sink = EventSink(str(tdir))
+    mgr = _mgr(tmp_path / "ck", sink=sink, async_save=True,
+               commit_window=2)
+    for s in (2, 4, 6):
+        mgr.save_async(s, _state(s, mesh=mesh), mesh=mesh)
+        assert mgr.last_requested_step() == s
+    mgr.wait()
+
+    assert mgr.all_steps() == [2, 4, 6]
+    assert mgr.latest_step() == 6
+    # restore proves the committed bytes are the snapshotted values
+    st = mgr.restore_latest(_state(0), mesh=mesh)
+    _assert_bit_exact(st.params, _state(6).params)
+    assert mgr.saved_topology(4)["mesh"] == {"data": 8, "spatial": 1}
+    mgr.close()
+    sink.close()
+
+    commits = [e for e in _events(str(tdir)) if e["event"] == "ckpt_commit"]
+    assert [c["step"] for c in commits] == [2, 4, 6]
+    for c in commits:
+        assert c["ok"] is True
+        assert c["commit_latency_s"] >= 0.0
+        assert c["queue_wait_s"] >= 0.0
+        assert "error" not in c
+
+
+def test_save_async_commit_failure_surfaces_on_wait(tmp_path):
+    """A dying disk in the committer thread must fail the run loudly:
+    the NEXT wait()/save_async() raises, with the original error
+    chained, and the ckpt_commit event records ok=False."""
+    tdir = tmp_path / "telemetry"
+    sink = EventSink(str(tdir))
+    mgr = _mgr(tmp_path / "ck", sink=sink, async_save=True)
+
+    boom = OSError("No space left on device")
+
+    def dying_save(*a, **k):
+        raise boom
+
+    mgr._mgr.save = dying_save
+    mgr.save_async(3, _state(3))
+    with pytest.raises(RuntimeError,
+                       match="background checkpoint commit failed") as ei:
+        mgr.wait()
+    assert ei.value.__cause__ is boom
+    # the error is consumed by the raise: a subsequent wait is clean
+    mgr.wait()
+    mgr.close()
+    sink.close()
+
+    commits = [e for e in _events(str(tdir)) if e["event"] == "ckpt_commit"]
+    assert len(commits) == 1
+    assert commits[0]["ok"] is False
+    assert "No space left" in commits[0]["error"]
+
+
+def test_save_async_probe_flags_torn_commit(tmp_path):
+    """The post-commit probe catches a save that lands torn (chaos
+    ``torn_ckpt`` tears AFTER the commit finishes): the event reports
+    ok=False but the step stays on disk for the fallback chain."""
+    from raft_tpu.chaos import FaultPlan
+
+    tdir = tmp_path / "telemetry"
+    sink = EventSink(str(tdir))
+    chaos.install(FaultPlan.parse("torn_ckpt@step=4"))
+    mgr = _mgr(tmp_path / "ck", sink=sink, async_save=True)
+    mgr.save_async(2, _state(2))
+    mgr.save_async(4, _state(4))
+    mgr.wait()
+
+    assert mgr.all_steps() == [2, 4]  # torn step stays listed
+    st = mgr.restore_latest(_state(0))  # fallback walks past it
+    assert int(st.step) == 2
+    mgr.close()
+    sink.close()
+
+    evs = _events(str(tdir))
+    torn = [e for e in evs if e["event"] == "chaos_torn_ckpt"]
+    assert [e["step"] for e in torn] == [4]
+    by_step = {e["step"]: e for e in evs if e["event"] == "ckpt_commit"}
+    assert by_step[2]["ok"] is True
+    assert by_step[4]["ok"] is False  # probe saw the torn files
+
+
+def test_plain_save_users_never_start_committer(tmp_path):
+    """Restore-only/offline-tool managers (verify-ckpt) must not spin
+    up the committer thread."""
+    mgr = _mgr(tmp_path / "ck", async_save=True)
+    mgr.save(1, _state(1))
+    mgr.wait()
+    assert mgr._commit_thread is None
+    assert mgr.last_requested_step() == 1
+    mgr.close()

@@ -452,3 +452,58 @@ def test_cli_train_pipeline_flags_parse():
                     "--device_prefetch", "0"])
     assert (b.accum_steps, b.prefetch_batches, b.device_prefetch) \
         == (2, 4, 0)
+
+
+def test_interrupt_predicate_unblocks_waiting_consumer():
+    """Satellite (PR 7): a preemption flag set while the consumer is
+    blocked in ``next()`` on an EMPTY buffer is observed within the
+    poll interval — ``PipelineInterrupted`` — instead of going unseen
+    until a batch arrives (the old SIGTERM-during-input-stall caveat).
+    The pipeline stays usable afterwards: not a stream error."""
+    import threading
+
+    from raft_tpu.data.prefetch import PipelineInterrupted
+
+    flag = threading.Event()
+    release = threading.Event()
+
+    def src():
+        yield {"x": np.zeros((2,), np.float32)}
+        release.wait(30.0)  # stall the producer: buffer stays empty
+        yield {"x": np.ones((2,), np.float32)}
+
+    pipe = DevicePipeline(src(), depth=2, interrupt=flag.is_set,
+                          interrupt_poll_s=0.02)
+    assert next(pipe)["x"][0] == 0.0
+    timer = threading.Timer(0.05, flag.set)
+    timer.start()
+    t0 = time.perf_counter()
+    with pytest.raises(PipelineInterrupted):
+        next(pipe)
+    # observed within ~poll interval of the flag flip, nowhere near the
+    # 30 s the blocked source would have held the old blocking get
+    assert time.perf_counter() - t0 < 5.0
+    timer.cancel()
+
+    flag.clear()
+    release.set()  # input resumes -> the same pipeline delivers
+    assert next(pipe)["x"][0] == 1.0
+    pipe.close()
+
+
+def test_interrupt_predicate_ignored_while_batches_buffered():
+    """The poll is backpressure-free: with batches in the buffer the
+    flag is never even consulted — delivery wins (the train loop's
+    preempt seam handles the flag between steps)."""
+    def src():
+        for i in range(3):
+            yield {"x": np.full((2,), float(i), np.float32)}
+
+    pipe = DevicePipeline(src(), depth=2, interrupt=lambda: True,
+                          interrupt_poll_s=0.02)
+    deadline = time.time() + 10.0
+    while pipe.buffered() < 1 and time.time() < deadline:
+        time.sleep(0.005)
+    assert pipe.buffered() >= 1
+    assert next(pipe)["x"][0] == 0.0  # delivered despite the true flag
+    pipe.close()

@@ -80,13 +80,33 @@ def test_enable_persistent_cache_creates_0700(tmp_path, monkeypatch):
     old_dir = jax.config.jax_compilation_cache_dir
     old_min = jax.config.jax_persistent_cache_min_compile_time_secs
     try:
-        assert enable_persistent_compile_cache() == str(target)
+        # force=True: the suite runs on the CPU backend, where the
+        # un-forced call refuses to enable the cache (deserialized
+        # XLA:CPU executables abort the process on this jaxlib).
+        assert enable_persistent_compile_cache(force=True) == str(target)
         assert stat.S_IMODE(os.stat(target).st_mode) == 0o700
         assert jax.config.jax_compilation_cache_dir == str(target)
     finally:
         jax.config.update("jax_compilation_cache_dir", old_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           old_min)
+
+
+def test_enable_persistent_cache_refuses_cpu_backend(tmp_path,
+                                                     monkeypatch):
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return
+    target = tmp_path / "jaxcache"
+    monkeypatch.setenv("RAFT_JAX_CACHE_DIR", str(target))
+    old_dir = jax.config.jax_compilation_cache_dir
+    try:
+        assert enable_persistent_compile_cache() == ""
+        assert not target.exists()
+        assert jax.config.jax_compilation_cache_dir == old_dir
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
 
 
 def test_step_profiler_anchors_window_on_resume(monkeypatch):
