@@ -17,7 +17,9 @@ from raft_tpu.chaos.errors import (
     InjectedCheckpointCorruption,
     InjectedDeviceError,
     InjectedProducerCrash,
+    InjectedReplicaKill,
     InjectedWorkerCrash,
+    ReplicaWedgedInterrupt,
     TRANSIENT_MARKERS,
     is_transient_error,
     tear_files,
@@ -44,7 +46,9 @@ __all__ = [
     "InjectedCheckpointCorruption",
     "InjectedDeviceError",
     "InjectedProducerCrash",
+    "InjectedReplicaKill",
     "InjectedWorkerCrash",
+    "ReplicaWedgedInterrupt",
     "Rule",
     "TRANSIENT_MARKERS",
     "active",

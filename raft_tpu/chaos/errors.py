@@ -41,6 +41,29 @@ class InjectedDeviceError(RuntimeError):
     transient = True
 
 
+class InjectedReplicaKill(RuntimeError):
+    """Chaos ``replica_kill``: the replica's device worker dies
+    mid-batch, taking the whole replica down (models a crashed engine
+    process / a lost device).  NOT transient for the in-replica retry
+    loop — the replica is gone, retrying on the same device cannot
+    help — but it IS a failover signal: the fleet router re-dispatches
+    the batch's requests on a sibling replica
+    (:func:`raft_tpu.serve.router.is_failover_error`)."""
+
+    transient = False
+    replica_fatal = True
+
+
+class ReplicaWedgedInterrupt(RuntimeError):
+    """Raised inside a replica's device worker when a ``replica_hang``
+    wedge is interrupted by the engine stopping (the supervisor
+    restarting the wedged replica).  The hung batch's requests fail
+    with this and the router retries them on a sibling."""
+
+    transient = False
+    replica_fatal = True
+
+
 #: Substrings of jax/XLA runtime-error messages that indicate a
 #: transient condition (mirrors the gRPC/absl status names TPU runtime
 #: errors carry).  DEADLINE_EXCEEDED/UNAVAILABLE/ABORTED are queue and

@@ -41,6 +41,18 @@ fault               seam (point)                 injected error
 ``torn_ckpt``       ``ckpt.save``                files torn post-commit
 ``restore_err``     ``ckpt.restore``             ``InjectedCheckpointCorruption``
 ``device_err``      ``serve.device``             ``InjectedDeviceError``
+``replica_kill``    ``serve.replica``            ``InjectedReplicaKill``
+                                                 (engine marks itself
+                                                 crashed; supervisor
+                                                 restarts it)
+``replica_hang``    ``serve.replica``            device worker wedges
+                                                 until the engine stops
+                                                 (health goes stalled;
+                                                 supervisor restarts)
+``replica_slow``    ``serve.replica``            device batch sleeps
+                                                 ``chaos_slow_s`` (the
+                                                 straggler the router
+                                                 hedges around)
 ``preempt``         ``train.preempt``            cooperative-preemption
                                                  flag set (SystemExit
                                                  143 at the boundary)

@@ -8,8 +8,17 @@ flow is float32 and PNG-style encodings lose the sign/scale):
 - ``POST /v1/flow``  body = ``np.savez(buf, image1=..., image2=...)``
   with two matching ``(H, W, 3)`` arrays (uint8 or float32, [0, 255]).
   Response 200: ``npz`` with ``flow`` ``(H, W, 2)`` float32 at the
-  original resolution.  Response 429 + ``Retry-After`` when the bounded
-  queue is full (shed load, retry with backoff); 400 on malformed input.
+  original resolution.  Response 429 when the bounded queue is full:
+  ``Retry-After`` header plus a structured JSON body
+  ``{"error", "queue_depth", "retry_after_s"}`` so clients can back
+  off programmatically; 400 on malformed input.
+
+With ``--replicas N`` (N > 1) the same endpoints front a supervised
+replica fleet (``raft_tpu/serve/fleet.py``): requests route through a
+health-gated router with failover + optional hedging, ``/v1/healthz``
+reports fleet readiness (200 while ANY replica serves), and
+``/metrics`` aggregates every replica's registry with a ``replica``
+label per sample.
 - ``GET /v1/stats``  JSON engine snapshot (latency percentiles,
   pairs/sec/chip, per-bucket compile counts).
 - ``GET /metrics``   Prometheus text exposition rendered from the same
@@ -41,6 +50,7 @@ from __future__ import annotations
 import argparse
 import io
 import json
+import math
 
 
 def parse_args(argv=None):
@@ -96,7 +106,9 @@ def parse_args(argv=None):
                         "before the batch fails; deterministic errors "
                         "always fail fast (docs/ROBUSTNESS.md)")
     p.add_argument("--retry-backoff-s", type=float, default=0.05,
-                   help="sleep before retry k is k * this")
+                   help="base of the exponential retry ladder: retry k "
+                        "sleeps this * 2^(k-1) (capped, jittered) "
+                        "under the total retry deadline")
     p.add_argument("--chaos", default=None,
                    help="fault-injection spec, e.g. 'device_err@batch=3'"
                         " (docs/ROBUSTNESS.md grammar); default "
@@ -104,6 +116,20 @@ def parse_args(argv=None):
     p.add_argument("--chaos-seed", type=int, default=None,
                    help="seed for probabilistic chaos rules "
                         "(default $RAFT_CHAOS_SEED or 0)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="engine replicas behind a health-gated router "
+                        "with failover (docs/SERVING.md fleet section); "
+                        "1 = single engine, no fleet layer")
+    p.add_argument("--aot-dir", default=None,
+                   help="AOT executable artifact directory: replica 0 "
+                        "exports its compiled executables here, every "
+                        "later engine build imports them (zero-compile "
+                        "warm start); default: fresh temp dir per fleet")
+    p.add_argument("--hedge-timeout-s", type=float, default=0.0,
+                   help="fleet mode: duplicate a still-unresolved "
+                        "request onto a second replica after this many "
+                        "seconds (0 = hedging off; set well above p99 "
+                        "batch time)")
     return p.parse_args(argv)
 
 
@@ -116,6 +142,9 @@ def _parse_hw_list(spec):
 
 
 def _make_handler(engine):
+    # ``engine`` is a serving facade: a bare InferenceEngine or a
+    # fleet's FlowRouter — both expose infer/health/stats/metrics_text
+    # (and raise the same QueueFullError), so one handler serves both.
     from http.server import BaseHTTPRequestHandler
 
     from raft_tpu.serve import QueueFullError
@@ -172,8 +201,16 @@ def _make_handler(engine):
             try:
                 flow = engine.infer(im1, im2)
             except QueueFullError as e:
-                self._reply_json(429, {"error": str(e)},
-                                 extra=[("Retry-After", "1")])
+                # Structured shed-load response: the client gets the
+                # machine-readable backoff hint both as the standard
+                # header (delta-seconds, so ceil) and in the body.
+                retry_s = float(getattr(e, "retry_after_s", 1.0))
+                self._reply_json(
+                    429, {"error": str(e),
+                          "queue_depth": int(getattr(e, "queue_depth", 0)),
+                          "retry_after_s": retry_s},
+                    extra=[("Retry-After",
+                            str(max(1, math.ceil(retry_s))))])
                 return
             except ValueError as e:
                 self._reply_json(400, {"error": str(e)})
@@ -187,7 +224,8 @@ def _make_handler(engine):
 
 def make_server(engine, host: str, port: int):
     """A ``ThreadingHTTPServer`` bound to ``host:port`` (port 0 picks a
-    free port — tests), serving the engine.  Caller owns lifecycle."""
+    free port — tests), serving the engine (or a fleet router — see
+    ``_make_handler``).  Caller owns lifecycle."""
     from http.server import ThreadingHTTPServer
 
     return ThreadingHTTPServer((host, port), _make_handler(engine))
@@ -243,33 +281,66 @@ def main(argv=None):
         if args.batch_sizes else None,
         stall_timeout_s=max(args.stall_timeout_s, 0.0),
         device_retries=max(args.device_retries, 0),
-        retry_backoff_s=max(args.retry_backoff_s, 0.0))
+        retry_backoff_s=max(args.retry_backoff_s, 0.0),
+        retry_backoff_max_s=max(ServeConfig.retry_backoff_max_s,
+                                args.retry_backoff_s),
+        # Fleet mode overrides this per engine build (FleetConfig owns
+        # the artifact dir); single-engine mode imports at construction.
+        aot_dir=args.aot_dir)
     sink = None
     if args.telemetry_dir:
         from raft_tpu.obs import EventSink
 
         sink = EventSink(args.telemetry_dir)
-    engine = InferenceEngine(variables, model_cfg, serve_cfg, sink=sink)
-    engine.start()
-    if args.warmup:
-        shapes = _parse_hw_list(args.warmup)
-        print(f"warmup: compiling {len(shapes)} shape(s)...", flush=True)
-        engine.warmup(shapes)
+    if args.replicas > 1:
+        from raft_tpu.serve import (FleetConfig, FlowRouter,
+                                    ReplicaFleet, RouterConfig)
 
-    server = make_server(engine, args.host, args.port)
+        warmup = _parse_hw_list(args.warmup) if args.warmup else ()
+        if args.warmup:
+            print(f"fleet warmup: compiling {len(warmup)} shape(s) on "
+                  "replica 0, AOT-importing on the rest...", flush=True)
+        fleet = ReplicaFleet(
+            variables, model_cfg, serve_cfg,
+            FleetConfig(replicas=args.replicas, aot_dir=args.aot_dir,
+                        warmup_shapes=warmup),
+            sink=sink)
+        fleet.start()
+        service = FlowRouter(
+            fleet,
+            RouterConfig(hedge_timeout_s=max(args.hedge_timeout_s, 0.0)),
+            sink=sink)
+        extra = f", replicas={args.replicas}, aot_dir={fleet.aot_dir}"
+    else:
+        engine = InferenceEngine(variables, model_cfg, serve_cfg,
+                                 sink=sink)
+        engine.start()
+        if args.warmup:
+            shapes = _parse_hw_list(args.warmup)
+            print(f"warmup: compiling {len(shapes)} shape(s)...",
+                  flush=True)
+            engine.warmup(shapes)
+        fleet = None
+        service = engine
+        extra = ""
+
+    server = make_server(service, args.host, args.port)
     host, port = server.server_address[:2]
     print(f"raft-tpu serve: listening on http://{host}:{port} "
           f"(backend={jax.default_backend()}, "
           f"max_batch={args.max_batch}, max_wait_ms={args.max_wait_ms}, "
-          f"max_queue={args.max_queue})", flush=True)
+          f"max_queue={args.max_queue}{extra})", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
         server.server_close()
-        engine.stop()
-        print(json.dumps(engine.stats()), flush=True)
+        if fleet is not None:
+            fleet.stop()
+        else:
+            service.stop()
+        print(json.dumps(service.stats()), flush=True)
 
 
 if __name__ == "__main__":

@@ -35,8 +35,22 @@ def _fmt(v) -> str:
     return repr(f)
 
 
-def render(registry) -> str:
+def render(registry, extra_labels=None) -> str:
+    """Render ``registry``; ``extra_labels`` (``{name: value}``) are
+    merged into EVERY exported sample — how the fleet router scopes each
+    replica's engine registry under ``replica="rN"`` in one aggregated
+    ``/metrics`` page without the engines knowing they are fleet
+    members.  An extra label colliding with a sample's own label loses
+    (the sample's value wins — it is more specific)."""
     import numpy as np
+
+    extra = tuple(sorted((k, str(v))
+                         for k, v in (extra_labels or {}).items()))
+
+    def merged(key):
+        have = {k for k, _ in key}
+        return tuple(sorted(key + tuple(
+            (k, v) for k, v in extra if k not in have)))
 
     registry.collect()
     lines = []
@@ -52,12 +66,13 @@ def render(registry) -> str:
             # `rate()` queries see the series from the first scrape.  A
             # never-set gauge stays absent: unknown is not zero.
             if m.kind == "counter":
-                lines.append(f"{m.name} 0")
+                lines.append(f"{m.name}{_labels(merged(()))} 0")
             elif m.kind == "histogram":
-                lines.append(f"{m.name}_sum 0")
-                lines.append(f"{m.name}_count 0")
+                lines.append(f"{m.name}_sum{_labels(merged(()))} 0")
+                lines.append(f"{m.name}_count{_labels(merged(()))} 0")
             continue
-        for key, v in items:
+        for raw_key, v in items:
+            key = merged(raw_key)
             if m.kind == "histogram":
                 count, total, window = v
                 if window:

@@ -8,16 +8,44 @@ Entry points::
     python scripts/bench_serve.py --tiny           # load generator
 """
 
+from raft_tpu.serve.aot import (
+    AOTImportError,
+    export_executables,
+    import_executables,
+    model_fingerprint,
+)
 from raft_tpu.serve.engine import (
     InferenceEngine,
     QueueFullError,
     ServeConfig,
 )
+from raft_tpu.serve.fleet import (
+    FleetConfig,
+    Replica,
+    ReplicaFleet,
+    WeightUpdateError,
+)
+from raft_tpu.serve.router import (
+    FlowRouter,
+    RouterConfig,
+    is_failover_error,
+)
 from raft_tpu.serve.stats import LatencyRecorder
 
 __all__ = [
+    "AOTImportError",
+    "FleetConfig",
+    "FlowRouter",
     "InferenceEngine",
-    "QueueFullError",
-    "ServeConfig",
     "LatencyRecorder",
+    "QueueFullError",
+    "Replica",
+    "ReplicaFleet",
+    "RouterConfig",
+    "ServeConfig",
+    "WeightUpdateError",
+    "export_executables",
+    "import_executables",
+    "is_failover_error",
+    "model_fingerprint",
 ]

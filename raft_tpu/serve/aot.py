@@ -1,0 +1,214 @@
+"""AOT executable export/import: zero-compile replica warm-start.
+
+The engine's compile cache is keyed ``(bucket_hw, batch)`` and each
+entry is an explicit ``jit.lower(...).compile()`` product
+(``jax.stages.Compiled``).  XLA lets those be serialized
+(``jax.experimental.serialize_executable``), and — crucially — the
+executable takes the *variables pytree as a runtime argument*, so one
+exported artifact warm-starts a replica with ANY weights of the same
+tree structure: a supervised restart after a crash AND the warming
+engine of a rolling weight update both import the same blobs and serve
+their first request with **zero JIT compiles**
+(``CompileCounter``-asserted in ``tests/test_fleet.py``).
+
+Artifact layout (one directory)::
+
+    manifest.json                  # fingerprint + key index (below)
+    trees.pkl                      # pickled in/out pytree TEMPLATES
+    exe-<H>x<W>-b<B>.bin           # one serialized executable per key
+
+``trees.pkl`` holds the call's input/output tree *structures* rendered
+as plain int-leaf templates (``treedef.unflatten(range(n))``) — plain
+dicts/tuples, no jax objects — because ``serialize()`` returns treedefs
+that are not themselves portable.  All keys share one structure (the
+specs differ only in leaf shapes, which live inside the blobs).
+
+Compatibility gate: an artifact is refused (``AOTImportError``) unless
+its fingerprint — model config + variables tree structure/shapes/dtypes
++ iters — AND backend AND jax version match the importing engine.  A
+stale artifact must fall back to lazy JIT compiles, never feed a
+request through the wrong program.  The engine treats import failure as
+a warm-start miss (``aot_import_error`` event), not a serve failure.
+
+Blobs are pickles (that is the upstream wire format); treat artifact
+directories with the same trust as checkpoint directories.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+from typing import Dict, Optional, Tuple
+
+MANIFEST = "manifest.json"
+TREES = "trees.pkl"
+FORMAT_VERSION = 1
+
+
+class AOTImportError(RuntimeError):
+    """Artifact missing/corrupt/incompatible — the importer refuses it
+    (the engine falls back to lazy JIT compiles)."""
+
+
+def _blob_name(key: tuple) -> str:
+    (h, w), bs = key
+    return f"exe-{h}x{w}-b{bs}.bin"
+
+
+def model_fingerprint(model_cfg, variables, iters: int) -> str:
+    """Hash of everything that must match for an exported executable to
+    be the RIGHT program: the model config, the variables pytree
+    structure + per-leaf shape/dtype, and the iteration count baked
+    into the traced call."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(variables)[0]
+    shapes = [(jax.tree_util.keystr(path), tuple(x.shape), str(x.dtype))
+              for path, x in leaves]
+    payload = json.dumps({
+        "config": {k: repr(v) for k, v in sorted(
+            dataclasses.asdict(model_cfg).items())},
+        "shapes": shapes,
+        # The full treedef, not just the leaves: an empty container
+        # (e.g. a checkpoint layout adding ``batch_stats: {}``) changes
+        # the executable's input pytree without changing any leaf, and
+        # a structure-blind fingerprint would import an executable the
+        # call site then cannot invoke.
+        "treedef": str(jax.tree_util.tree_structure(variables)),
+        "iters": int(iters),
+    }, sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def _env_stamp() -> dict:
+    import jax
+
+    return {"jax": jax.__version__,
+            "backend": jax.default_backend()}
+
+
+def export_executables(executables: Dict[tuple, object], path: str, *,
+                       fingerprint: str) -> dict:
+    """Serialize ``{(bucket, batch): Compiled}`` into directory
+    ``path`` (atomic per file: tmp + rename, so a concurrent importer
+    never sees a torn blob).  Returns the manifest written.  Keys
+    already exported with identical bytes are overwritten in place —
+    export is idempotent and may be re-run as the compile cache
+    grows."""
+    from jax.experimental import serialize_executable as se
+
+    if not executables:
+        raise ValueError("nothing to export: empty executable cache "
+                         "(warm the engine first)")
+    os.makedirs(path, exist_ok=True)
+    keys, trees = [], None
+    for key, exe in sorted(executables.items()):
+        ser, in_tree, out_tree = se.serialize(exe)
+        if trees is None:
+            trees = (in_tree.unflatten(list(range(in_tree.num_leaves))),
+                     out_tree.unflatten(list(range(out_tree.num_leaves))))
+        blob = _blob_name(key)
+        _atomic_write(os.path.join(path, blob), ser)
+        keys.append({"bucket": list(key[0]), "batch": int(key[1]),
+                     "file": blob,
+                     "sha256": hashlib.sha256(ser).hexdigest(),
+                     "bytes": len(ser)})
+    _atomic_write(os.path.join(path, TREES), pickle.dumps(trees))
+    manifest = dict(_env_stamp(), format_version=FORMAT_VERSION,
+                    fingerprint=fingerprint, keys=keys)
+    _atomic_write(os.path.join(path, MANIFEST),
+                  json.dumps(manifest, indent=1).encode())
+    return manifest
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                               prefix=".aot-tmp-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def read_manifest(path: str) -> dict:
+    mpath = os.path.join(path, MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except OSError as e:
+        raise AOTImportError(f"no AOT manifest at {mpath}: {e}")
+    except ValueError as e:
+        raise AOTImportError(f"corrupt AOT manifest {mpath}: {e}")
+    if manifest.get("format_version") != FORMAT_VERSION:
+        raise AOTImportError(
+            f"AOT artifact format {manifest.get('format_version')!r} "
+            f"!= supported {FORMAT_VERSION}")
+    return manifest
+
+
+def import_executables(path: str, *, fingerprint: str,
+                       keys: Optional[Tuple[tuple, ...]] = None
+                       ) -> Dict[tuple, object]:
+    """Load ``{(bucket, batch): Compiled}`` from an artifact directory,
+    gated on ``fingerprint`` + backend + jax version.  ``keys``
+    restricts the import (default: everything in the manifest).  Raises
+    :class:`AOTImportError` on any mismatch or corruption — partial
+    results are never returned (an artifact either warm-starts the
+    whole ladder or is refused)."""
+    import jax
+    from jax.experimental import serialize_executable as se
+
+    manifest = read_manifest(path)
+    env = _env_stamp()
+    for field, want in (("fingerprint", fingerprint),
+                        ("jax", env["jax"]),
+                        ("backend", env["backend"])):
+        got = manifest.get(field)
+        if got != want:
+            raise AOTImportError(
+                f"AOT artifact {field} mismatch: artifact has {got!r}, "
+                f"this engine needs {want!r} (stale export? re-run "
+                "export on this build)")
+    try:
+        with open(os.path.join(path, TREES), "rb") as f:
+            in_template, out_template = pickle.load(f)
+    except (OSError, pickle.UnpicklingError, ValueError, EOFError) as e:
+        raise AOTImportError(f"corrupt AOT tree templates: {e}")
+    in_tree = jax.tree_util.tree_structure(in_template)
+    out_tree = jax.tree_util.tree_structure(out_template)
+
+    wanted = None if keys is None else {
+        (tuple(b), int(bs)) for (b, bs) in keys}
+    out: Dict[tuple, object] = {}
+    for entry in manifest["keys"]:
+        key = (tuple(entry["bucket"]), int(entry["batch"]))
+        if wanted is not None and key not in wanted:
+            continue
+        blob_path = os.path.join(path, entry["file"])
+        try:
+            with open(blob_path, "rb") as f:
+                ser = f.read()
+        except OSError as e:
+            raise AOTImportError(f"missing AOT blob {blob_path}: {e}")
+        if hashlib.sha256(ser).hexdigest() != entry["sha256"]:
+            raise AOTImportError(
+                f"AOT blob {entry['file']} checksum mismatch "
+                "(torn write?)")
+        try:
+            out[key] = se.deserialize_and_load(ser, in_tree, out_tree)
+        except Exception as e:
+            raise AOTImportError(
+                f"AOT blob {entry['file']} failed to deserialize: "
+                f"{type(e).__name__}: {e}")
+    if not out:
+        raise AOTImportError(
+            f"AOT artifact at {path} holds none of the requested keys")
+    return out

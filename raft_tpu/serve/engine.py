@@ -59,7 +59,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu import chaos
-from raft_tpu.chaos import InjectedDeviceError, is_transient_error
+from raft_tpu.chaos import (InjectedDeviceError, InjectedReplicaKill,
+                            ReplicaWedgedInterrupt, is_transient_error)
 from raft_tpu.config import RAFTConfig
 from raft_tpu.obs import EventSink, MetricRegistry
 from raft_tpu.ops.pad import InputPadder, bucket_hw
@@ -71,7 +72,18 @@ class QueueFullError(RuntimeError):
     """Backpressure rejection: ``max_queue`` requests already in flight.
 
     The 429-style signal — the caller should shed load or retry with
-    backoff; the engine never queues without bound."""
+    backoff; the engine never queues without bound.  Carries the
+    structured overload detail the HTTP layer returns (429 JSON body +
+    ``Retry-After`` header) so load generators and the fleet router can
+    back off *proportionally* instead of hammering: ``queue_depth`` is
+    the in-flight count at rejection time, ``retry_after_s`` the
+    suggested wait."""
+
+    def __init__(self, msg: str, *, queue_depth: int = 0,
+                 retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.queue_depth = int(queue_depth)
+        self.retry_after_s = float(retry_after_s)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,8 +107,23 @@ class ServeConfig:
     transient (:func:`raft_tpu.chaos.is_transient_error`) before the
     whole batch fails — one flaky dispatch no longer 500s every
     co-batched request; deterministic errors always fail fast
-    (docs/ROBUSTNESS.md).  ``retry_backoff_s`` is the sleep before
-    attempt k (linear: ``k * retry_backoff_s``)."""
+    (docs/ROBUSTNESS.md).  Retry backoff is EXPONENTIAL with jitter:
+    attempt k sleeps ``min(retry_backoff_s * 2^(k-1),
+    retry_backoff_max_s)`` scaled by a ±``retry_jitter`` fraction
+    (seeded per engine, so chaos drills replay), and the whole retry
+    ladder is capped by ``retry_deadline_s`` measured from the first
+    failure — a batch never spends longer retrying than a client would
+    plausibly wait.  The actual sleep lands in each ``serve_retry``
+    event (``backoff_s``) so drills can assert the schedule.
+    ``retry_after_s``: the backoff hint a 429 rejection carries.
+    ``aot_dir``: warm-start artifact directory
+    (``raft_tpu/serve/aot.py``) — compatible ``(bucket, batch)``
+    executables are imported at construction so the first request
+    compiles NOTHING; an incompatible/corrupt artifact is skipped
+    (``aot_import_error`` event) and the engine compiles lazily.
+    ``chaos_slow_s``/``chaos_hang_max_s`` size the injected
+    ``replica_slow`` straggler sleep and the ``replica_hang`` wedge cap
+    (drills only; no effect without an installed fault plan)."""
 
     iters: int = 32
     max_batch: int = 8
@@ -110,6 +137,13 @@ class ServeConfig:
     stall_timeout_s: float = 120.0
     device_retries: int = 1
     retry_backoff_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    retry_jitter: float = 0.25
+    retry_deadline_s: float = 10.0
+    retry_after_s: float = 1.0
+    aot_dir: Optional[str] = None
+    chaos_slow_s: float = 0.5
+    chaos_hang_max_s: float = 30.0
 
     def __post_init__(self):
         if self.max_batch < 1 or self.max_queue < 1:
@@ -121,6 +155,12 @@ class ServeConfig:
         if self.device_retries < 0 or self.retry_backoff_s < 0:
             raise ValueError(
                 "device_retries and retry_backoff_s must be >= 0")
+        if (self.retry_backoff_max_s < self.retry_backoff_s
+                or self.retry_deadline_s <= 0
+                or not 0 <= self.retry_jitter < 1):
+            raise ValueError(
+                "need retry_backoff_max_s >= retry_backoff_s, "
+                "retry_deadline_s > 0 and 0 <= retry_jitter < 1")
         m = self.bucket_multiple
         for hw in self.buckets or ():
             if hw[0] % m or hw[1] % m:
@@ -195,6 +235,19 @@ class InferenceEngine:
 
         self._executables: Dict[tuple, object] = {}
         self._compile_lock = threading.Lock()
+        # Crash/stop state: ``crashed`` holds the reason string once the
+        # device worker hit a fatal (replica-killing) fault — the fleet
+        # supervisor polls it through health(); ``_stopped`` makes a
+        # post-stop submit() fail with a CLEAR error instead of the
+        # ambiguous not-started one.
+        self.crashed: Optional[str] = None
+        self._stopped = False
+        # stop() must be idempotent under CONCURRENT callers: the fleet
+        # supervisor restarting a crashed replica can race fleet.stop().
+        self._stop_lock = threading.Lock()
+        # Seeded per-engine jitter source for the retry backoff ladder
+        # (chaos drills must replay the recorded backoff_s values).
+        self._retry_rng = np.random.default_rng(0)
         # One registry per engine: every stats/exposition figure below
         # reads these same metric objects (see serve/stats.py), and
         # cli/serve.py renders them at GET /metrics.
@@ -217,7 +270,13 @@ class InferenceEngine:
         # Serve-side stall signal: perf_counter of the last COMPLETED
         # device batch (success or failure — either proves the device
         # worker is alive) and of start(); health() derives readiness.
+        # _pending_since marks the 0 -> nonzero transition: a stall is
+        # measured from when the waiting work ARRIVED, never from a
+        # batch completed before an idle stretch (else a replica idle
+        # longer than stall_timeout_s reads as stalled the instant a
+        # request lands, and the fleet supervisor would restart it).
         self._last_batch_done: Optional[float] = None
+        self._pending_since: Optional[float] = None
         self._t_started: Optional[float] = None
         self._stale_gauge = self.registry.gauge(
             "raft_serve_seconds_since_last_batch",
@@ -236,6 +295,59 @@ class InferenceEngine:
             max_workers=1, thread_name_prefix="raft-serve-device")
         self._accepting = False
 
+        # AOT warm-start (raft_tpu/serve/aot.py): import serialized
+        # executables so the first request compiles nothing.  The
+        # fingerprint binds artifact to (model config, variables tree
+        # shapes/dtypes, iters) — the executable takes variables as a
+        # runtime argument, so the same artifact warm-starts restarted
+        # replicas AND rolling-update engines carrying NEW weights.
+        from raft_tpu.serve import aot as aot_mod
+
+        self._aot_fingerprint = aot_mod.model_fingerprint(
+            model_cfg, self._variables, cfg.iters)
+        self.aot_info: dict = {"dir": cfg.aot_dir, "imported": 0,
+                               "ok": None}
+        if cfg.aot_dir:
+            self._preload_aot(cfg.aot_dir)
+
+    # ------------------------------------------------------------------
+    # AOT warm-start artifacts
+    # ------------------------------------------------------------------
+
+    def _preload_aot(self, directory: str) -> None:
+        from raft_tpu.serve import aot as aot_mod
+
+        try:
+            exes = aot_mod.import_executables(
+                directory, fingerprint=self._aot_fingerprint)
+        except aot_mod.AOTImportError as e:
+            # A warm-start MISS, not a serve failure: log it and fall
+            # back to lazy JIT compiles.
+            self.aot_info.update(ok=False, error=str(e))
+            self._sink.emit("aot_import_error", dir=directory,
+                            error=str(e)[:300])
+            return
+        with self._compile_lock:
+            self._executables.update(exes)
+        self.aot_info.update(ok=True, imported=len(exes))
+        self._sink.emit("aot_import", dir=directory, keys=len(exes))
+
+    def export_aot(self, directory: str) -> dict:
+        """Serialize every compiled ``(bucket, batch)`` executable into
+        ``directory`` (atomic per file) so a fresh engine built with
+        ``ServeConfig(aot_dir=directory)`` serves its first request
+        with zero compiles.  Returns the manifest.  Raises when the
+        cache is empty (warm up first)."""
+        from raft_tpu.serve import aot as aot_mod
+
+        with self._compile_lock:
+            exes = dict(self._executables)
+        manifest = aot_mod.export_executables(
+            exes, directory, fingerprint=self._aot_fingerprint)
+        self._sink.emit("aot_export", dir=directory,
+                        keys=len(manifest["keys"]))
+        return manifest
+
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
@@ -243,6 +355,11 @@ class InferenceEngine:
     def start(self) -> "InferenceEngine":
         if self._thread is not None:
             raise RuntimeError("engine already started")
+        if self._stopped:
+            raise RuntimeError(
+                "engine stopped — engines are single-use; build a new "
+                "InferenceEngine (the fleet supervisor does this on "
+                "restart)")
         self._loop = asyncio.new_event_loop()
         started = threading.Event()
 
@@ -265,9 +382,15 @@ class InferenceEngine:
 
         Queued requests that cannot complete (``drain=False`` or drain
         timeout) fail with ``RuntimeError('engine stopped')``."""
+        with self._stop_lock:
+            self._stop_locked(drain, timeout)
+
+    def _stop_locked(self, drain: bool, timeout: float) -> None:
         if self._thread is None:
+            self._stopped = True
             return
         self._accepting = False
+        self._stopped = True
         if drain:
             deadline = time.perf_counter() + timeout
             while time.perf_counter() < deadline:
@@ -309,6 +432,16 @@ class InferenceEngine:
         Raises :class:`QueueFullError` immediately (never blocks) when
         ``max_queue`` requests are already in flight."""
         if not self._accepting:
+            # Fail FAST with the precise lifecycle state — a client
+            # racing stop() must get an immediate, classifiable error
+            # (the fleet router treats it as a failover signal), never
+            # a hang on a dead loop.
+            if self.crashed:
+                raise RuntimeError(f"engine crashed: {self.crashed}")
+            if self._stopped:
+                raise RuntimeError(
+                    "engine stopped — engines are single-use; build a "
+                    "new InferenceEngine or route to a live replica")
             raise RuntimeError("engine not started (or stopping)")
         im1 = np.asarray(image1, dtype=np.float32)
         im2 = np.asarray(image2, dtype=np.float32)
@@ -324,7 +457,12 @@ class InferenceEngine:
                 self._counters.add_rejected()
                 raise QueueFullError(
                     f"{self._pending} requests in flight >= max_queue="
-                    f"{self.cfg.max_queue}; retry later")
+                    f"{self.cfg.max_queue}; retry after "
+                    f"{self.cfg.retry_after_s:g}s",
+                    queue_depth=self._pending,
+                    retry_after_s=self.cfg.retry_after_s)
+            if self._pending == 0:
+                self._pending_since = time.perf_counter()
             self._pending += 1
         req = _Request(im1, im2, bucket, padder)
         try:
@@ -355,6 +493,13 @@ class InferenceEngine:
                 keys.append((bucket, int(bs)))
         return keys
 
+    def compiled_keys(self) -> List[tuple]:
+        """``(bucket, batch)`` keys currently in the compile cache
+        (compiled here or AOT-imported) — what :meth:`export_aot` would
+        serialize."""
+        with self._compile_lock:
+            return sorted(self._executables)
+
     def _collect_pending(self, _reg) -> None:
         with self._pending_lock:
             pending = self._pending
@@ -369,23 +514,28 @@ class InferenceEngine:
         Liveness alone ("the HTTP thread answers") misses the real
         failure mode: a wedged device worker with requests piling up.
         Not-ready ⇔ accepting is off, OR requests are pending and no
-        device batch has completed within ``stall_timeout_s`` (measured
-        from the last completed batch, or from ``start()`` when none
-        has completed yet)."""
+        device batch has completed within ``stall_timeout_s`` of the
+        NEWEST of {last completed batch, when the pending backlog
+        started, start()} — the backlog term keeps a long-idle replica
+        from reading as stalled the instant traffic resumes."""
         now = time.perf_counter()
         with self._pending_lock:
             pending = self._pending
             last = self._last_batch_done
+            pending_since = self._pending_since
         since = None if last is None else now - last
         stalled = False
         if self.cfg.stall_timeout_s and pending > 0:
-            ref = last if last is not None else self._t_started
-            stalled = (ref is not None
-                       and now - ref > self.cfg.stall_timeout_s)
+            refs = [t for t in (last, pending_since, self._t_started)
+                    if t is not None]
+            stalled = (bool(refs)
+                       and now - max(refs) > self.cfg.stall_timeout_s)
         return {
-            "ready": bool(self._accepting and not stalled),
+            "ready": bool(self._accepting and not stalled
+                          and not self.crashed),
             "accepting": bool(self._accepting),
             "stalled": stalled,
+            "crashed": self.crashed,
             "pending": pending,
             "seconds_since_last_batch":
                 None if since is None else round(since, 3),
@@ -416,6 +566,9 @@ class InferenceEngine:
         # replica from one running hand-rolled defaults.
         out["tuning"] = dict(self.tuning_info.stamp(),
                              applied=dict(self.tuning_info.applied))
+        # AOT warm-start provenance: how many executables this engine
+        # imported instead of compiling (docs/SERVING.md fleet section).
+        out["aot"] = dict(self.aot_info)
         return out
 
     # ------------------------------------------------------------------
@@ -491,14 +644,19 @@ class InferenceEngine:
 
         Errors classified transient (:func:`is_transient_error` — flaky
         dispatch/transport, or the injected ``device_err`` fault) are
-        retried up to ``cfg.device_retries`` times with linear backoff,
-        each retry counted (``raft_serve_device_retries_total``) and
-        logged as a ``serve_retry`` event; anything deterministic
-        (shape/dtype/compile errors) raises on the first attempt.  The
-        host-side pad/stack is NOT inside the retry: it is
-        deterministic, so re-running it could only repeat its failure.
-        """
+        retried up to ``cfg.device_retries`` times with EXPONENTIAL
+        backoff + jitter under a total ``retry_deadline_s`` cap
+        (linear backoff hammered a recovering device runtime in lock
+        step; the jitter de-correlates co-located replicas), each retry
+        counted (``raft_serve_device_retries_total``) and logged as a
+        ``serve_retry`` event carrying the ACTUAL ``backoff_s`` slept —
+        chaos drills assert the schedule from the event stream.
+        Anything deterministic (shape/dtype/compile errors) raises on
+        the first attempt.  The host-side pad/stack is NOT inside the
+        retry: it is deterministic, so re-running it could only repeat
+        its failure."""
         attempt = 0
+        t_first_try = time.perf_counter()
         while True:
             try:
                 if chaos.should_inject("device_err", step=seq,
@@ -515,12 +673,67 @@ class InferenceEngine:
                         or not is_transient_error(e):
                     raise
                 attempt += 1
+                base = min(self.cfg.retry_backoff_s * 2 ** (attempt - 1),
+                           self.cfg.retry_backoff_max_s)
+                backoff = base * (1.0 + self.cfg.retry_jitter
+                                  * float(self._retry_rng.uniform(-1, 1)))
+                elapsed = time.perf_counter() - t_first_try
+                if elapsed + backoff > self.cfg.retry_deadline_s:
+                    # Total-deadline cap: the ladder must not outlive
+                    # what a waiting client would tolerate.
+                    self._sink.emit(
+                        "serve_retry_deadline",
+                        bucket=f"{bucket[0]}x{bucket[1]}",
+                        attempt=attempt, elapsed_s=round(elapsed, 4),
+                        deadline_s=self.cfg.retry_deadline_s)
+                    raise
                 self._counters.add_retry()
                 self._sink.emit("serve_retry",
                                 bucket=f"{bucket[0]}x{bucket[1]}",
                                 attempt=attempt,
+                                backoff_s=round(backoff, 6),
+                                elapsed_s=round(elapsed, 4),
                                 error=f"{type(e).__name__}: {e}")
-                time.sleep(self.cfg.retry_backoff_s * attempt)
+                time.sleep(backoff)
+
+    def _crash(self, reason: str) -> None:
+        """Mark this replica dead (fleet supervisor restarts it): stop
+        accepting, stamp the reason, emit the forensic event.  In-flight
+        and queued requests fail with replica-fatal errors the router
+        classifies as failover signals."""
+        self.crashed = reason
+        self._accepting = False
+        self._sink.emit("replica_crash", reason=reason[:300])
+
+    def _chaos_replica_faults(self, seq: int) -> None:
+        """The ``serve.replica`` injection seam (device-worker thread,
+        step context = device-batch ordinal): the three replica-level
+        faults the fleet drill kills/hangs/slows a member with.  No
+        plan installed = three module-global ``None`` checks."""
+        if chaos.should_inject("replica_slow", step=seq,
+                               point="serve.replica"):
+            # A straggler, not a failure: the batch completes late —
+            # what the router's bounded hedge exists to cover.
+            time.sleep(self.cfg.chaos_slow_s)
+        if chaos.should_inject("replica_hang", step=seq,
+                               point="serve.replica"):
+            # Wedge the (single) device worker: health() turns stalled
+            # once stall_timeout_s passes with requests pending, the
+            # supervisor stops the engine, and the poll below notices
+            # and aborts the batch with a replica-fatal error.
+            t0 = time.perf_counter()
+            while (self._accepting
+                   and time.perf_counter() - t0
+                   < self.cfg.chaos_hang_max_s):
+                time.sleep(0.02)
+            raise ReplicaWedgedInterrupt(
+                f"chaos-injected device wedge interrupted after "
+                f"{time.perf_counter() - t0:.2f}s (batch {seq})")
+        if chaos.should_inject("replica_kill", step=seq,
+                               point="serve.replica"):
+            self._crash(f"chaos-injected replica kill (batch {seq})")
+            raise InjectedReplicaKill(
+                f"chaos-injected replica kill (batch {seq})")
 
     def _run_batch(self, bucket: tuple, reqs: List[_Request]) -> None:
         n = len(reqs)
@@ -528,6 +741,7 @@ class InferenceEngine:
         t_start = time.perf_counter()
         self._batch_seq += 1
         try:
+            self._chaos_replica_faults(self._batch_seq)
             exe = self._get_executable(bucket, bs)
             im1 = [r.padder.pad_np(r.image1) for r in reqs]
             im2 = [r.padder.pad_np(r.image2) for r in reqs]

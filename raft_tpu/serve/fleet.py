@@ -1,0 +1,657 @@
+"""Self-healing replica fleet: N supervised engines behind one router
+(docs/SERVING.md, "Fleet serving").
+
+One :class:`~raft_tpu.serve.engine.InferenceEngine` is a single point
+of failure: a crashed device worker takes every in-flight and future
+request with it.  The fleet owns N engine replicas and keeps the
+*service* alive across individual replica death:
+
+- **Supervision** — a supervisor thread polls each replica's
+  ``health()``; a crashed or stalled engine is stopped and REPLACED
+  (engines are single-use) with a fresh one, after an exponential
+  backoff with jitter (``restart_backoff_s`` doubling to
+  ``restart_backoff_max_s``; the jitter keeps co-scheduled replicas
+  from thundering back in lock step).  ``max_restart_failures``
+  consecutive failed *rebuild attempts* mark the replica ``failed`` —
+  a replica that cannot even construct an engine is a config problem,
+  not a transient.
+- **AOT warm-start** — replica 0 compiles the warmup ladder once and
+  exports the executables (``raft_tpu/serve/aot.py``); every later
+  engine build — fleet bring-up, supervised restart, rolling-update
+  warming — imports them and serves its first request with **zero JIT
+  compiles**.  The executable takes the variables pytree as a runtime
+  argument, so the same artifact warm-starts an engine carrying NEW
+  weights.
+- **Rolling weight updates** — :meth:`ReplicaFleet.update_weights`
+  loads checkpoint N+1 on a *warming* engine while the fleet keeps
+  serving N, and flips replicas one at a time (old engine drains, new
+  one takes over atomically under the replica lock) ONLY after two
+  gates pass: the checkpoint verifies (an actual restore of the newest
+  step — the only check that proves the bytes decode, same machinery
+  as ``verify-ckpt``) and a canary inference on the warming engine
+  returns finite flow of the right shape.  A torn checkpoint or a
+  NaN-producing weight set is refused with
+  :class:`WeightUpdateError`; the fleet keeps serving version N.
+
+The fleet does placement-free supervision only; request routing
+(affinity, failover, hedging) lives in
+:class:`raft_tpu.serve.router.FlowRouter`, which reads this module's
+:class:`Replica` objects.  ``metrics_text()`` aggregates every
+replica's registry into one exposition, each sample labeled
+``replica="rK"``, with fleet-level counters (restarts, weight updates)
+on top — one scrape shows the whole fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.obs import EventSink, MetricRegistry
+from raft_tpu.obs.exposition import render as render_metrics
+from raft_tpu.serve.engine import InferenceEngine, ServeConfig
+
+
+class WeightUpdateError(RuntimeError):
+    """A rolling weight update was refused at a gate (checkpoint failed
+    to verify, canary inference failed) or could not complete.  The
+    fleet keeps serving its current weights."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet supervision knobs (:class:`ReplicaFleet`)."""
+
+    replicas: int = 2
+    #: Restart backoff ladder: ``restart_backoff_s * 2^k`` capped at
+    #: ``restart_backoff_max_s``, ±``restart_jitter`` fraction.  The
+    #: level resets after a replica stays healthy ``backoff_reset_s``.
+    restart_backoff_s: float = 0.2
+    restart_backoff_max_s: float = 10.0
+    restart_jitter: float = 0.2
+    backoff_reset_s: float = 30.0
+    #: Consecutive failed engine REBUILDS (not crashes) before a
+    #: replica is marked ``failed`` and left down.
+    max_restart_failures: int = 5
+    health_poll_s: float = 0.1
+    #: AOT artifact directory (default: a fresh temp dir per fleet).
+    aot_dir: Optional[str] = None
+    #: Export replica 0's compiled executables after warmup so later
+    #: engine builds import instead of compiling.
+    auto_export_aot: bool = True
+    #: Raw (H, W) image shapes replica 0 pre-compiles at bring-up (and
+    #: the canary shapes for weight updates, unless overridden).
+    warmup_shapes: Tuple[Tuple[int, int], ...] = ()
+    canary_shapes: Tuple[Tuple[int, int], ...] = ()
+    drain_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if self.restart_backoff_max_s < self.restart_backoff_s:
+            raise ValueError(
+                "restart_backoff_max_s must be >= restart_backoff_s")
+        if not 0 <= self.restart_jitter < 1:
+            raise ValueError("restart_jitter must be in [0, 1)")
+        if self.max_restart_failures < 1:
+            raise ValueError("max_restart_failures must be >= 1")
+
+
+class _LabeledSink:
+    """EventSink wrapper stamping ``replica=<name>`` onto every event an
+    engine emits, so one shared JSONL stream stays attributable."""
+
+    def __init__(self, inner: EventSink, **labels):
+        self._inner = inner
+        self._labels = labels
+
+    def emit(self, event, step=None, **fields):
+        self._inner.emit(event, step=step, **{**self._labels, **fields})
+
+    def relabel(self, **labels):
+        """Re-stamp (the warming engine becomes a named replica when a
+        rolling update adopts it)."""
+        self._labels.update(labels)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class Replica:
+    """One supervised fleet member: a name (``rK``), the CURRENT engine
+    (swapped under the lock on restart and weight flip), a state
+    machine, and the router-facing breaker state.
+
+    States: ``init`` → ``ready`` ⇄ ``restarting`` → ``failed`` /
+    ``stopped``.  ``generation`` increments on every engine swap — the
+    router uses it to avoid striking a fresh engine for its
+    predecessor's failures."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.name = f"r{index}"
+        self._lock = threading.RLock()
+        self._engine: Optional[InferenceEngine] = None
+        self.state = "init"
+        self.restarts = 0
+        self.generation = 0
+        # breaker (router-managed, supervisor-reset)
+        self._consec_failures = 0
+        self._broken_until = 0.0
+        # supervisor book-keeping
+        self.restart_failures = 0
+        self.backoff_level = 0
+        self.ready_since: Optional[float] = None
+
+    @property
+    def engine(self) -> Optional[InferenceEngine]:
+        with self._lock:
+            return self._engine
+
+    def adopt(self, engine: InferenceEngine) -> Optional[InferenceEngine]:
+        """Atomically make ``engine`` this replica's engine; returns the
+        previous one (caller stops/drains it).  Resets the breaker —
+        strikes belong to the old engine."""
+        with self._lock:
+            old, self._engine = self._engine, engine
+            self.generation += 1
+            self._consec_failures = 0
+            self._broken_until = 0.0
+            return old
+
+    def set_state(self, state: str) -> None:
+        with self._lock:
+            self.state = state
+            if state == "ready":
+                self.ready_since = time.monotonic()
+
+    def pending(self) -> int:
+        eng = self.engine
+        if eng is None:
+            return 0
+        return int(eng.health()["pending"])
+
+    def breaker_open(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._broken_until
+
+    def note_failure(self, threshold: int, cooldown_s: float) -> None:
+        with self._lock:
+            self._consec_failures += 1
+            if self._consec_failures >= threshold:
+                self._broken_until = time.monotonic() + cooldown_s
+
+    def note_success(self) -> None:
+        with self._lock:
+            self._consec_failures = 0
+
+    def eligible(self) -> bool:
+        """Router health gate: ready state, closed breaker, and the
+        engine itself reporting ready."""
+        with self._lock:
+            if self.state != "ready" or self._engine is None:
+                return False
+            if time.monotonic() < self._broken_until:
+                return False
+            eng = self._engine
+        return bool(eng.health()["ready"])
+
+
+class ReplicaFleet:
+    """See module docstring.  Lifecycle::
+
+        fleet = ReplicaFleet(variables, model_cfg, serve_cfg,
+                             FleetConfig(replicas=2,
+                                         warmup_shapes=[(436, 1024)]))
+        fleet.start()
+        router = FlowRouter(fleet)
+        flow = router.infer(image1, image2)
+        fleet.update_weights("/ckpts/run1")   # rolling, gated
+        fleet.stop()
+    """
+
+    def __init__(self, variables, model_cfg,
+                 serve_cfg: ServeConfig = ServeConfig(),
+                 fleet_cfg: FleetConfig = FleetConfig(), *,
+                 registry: Optional[MetricRegistry] = None,
+                 sink: Optional[EventSink] = None):
+        self.model_cfg = model_cfg
+        self.serve_cfg = serve_cfg
+        self.fleet_cfg = fleet_cfg
+        self.registry = registry or MetricRegistry()
+        self._sink = sink if sink is not None else EventSink.from_env()
+        self._variables = variables
+        self._var_lock = threading.Lock()
+        self.weights_version = 1
+        self.replicas: List[Replica] = [
+            Replica(i) for i in range(fleet_cfg.replicas)]
+        self.aot_dir = fleet_cfg.aot_dir or tempfile.mkdtemp(
+            prefix="raft-aot-")
+        self._started = False
+        self._stop_event = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+        self._update_lock = threading.Lock()
+        self._warming: Optional[InferenceEngine] = None
+        self._backoff_rng = np.random.default_rng(0)
+
+        self._restarts = self.registry.counter(
+            "raft_fleet_restarts_total",
+            "supervised replica restarts, by replica and reason")
+        self._weight_updates = self.registry.counter(
+            "raft_fleet_weight_updates_total",
+            "rolling weight updates, by outcome")
+        self._replica_gauge = self.registry.gauge(
+            "raft_fleet_replicas", "replicas by current state")
+        self._version_gauge = self.registry.gauge(
+            "raft_fleet_weights_version", "serving weights version")
+        self.registry.add_collect_hook(self._collect)
+
+    def _collect(self, _reg) -> None:
+        states: Dict[str, int] = {}
+        for r in self.replicas:
+            states[r.state] = states.get(r.state, 0) + 1
+        for state, n in states.items():
+            self._replica_gauge.set(n, state=state)
+        self._version_gauge.set(self.weights_version)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def _build_engine(self, variables=None,
+                      replica: str = "?") -> InferenceEngine:
+        with self._var_lock:
+            v = self._variables if variables is None else variables
+        cfg = dataclasses.replace(self.serve_cfg, aot_dir=self.aot_dir)
+        return InferenceEngine(v, self.model_cfg, cfg,
+                               sink=_LabeledSink(self._sink,
+                                                 replica=replica))
+
+    def start(self) -> "ReplicaFleet":
+        """Bring the fleet up: replica 0 warms (compiling whatever the
+        AOT artifact doesn't already cover) and exports the compile
+        cache; the rest import it and come up compile-free."""
+        if self._started:
+            raise RuntimeError("fleet already started")
+        self._started = True
+        for r in self.replicas:
+            eng = self._build_engine(replica=r.name)
+            eng.start()
+            if self.fleet_cfg.warmup_shapes:
+                # Cache-hit no-op on replicas whose AOT import covered
+                # the ladder; compiles on replica 0 (or any AOT miss).
+                eng.warmup(self.fleet_cfg.warmup_shapes)
+            r.adopt(eng)
+            r.set_state("ready")
+            if (r.index == 0 and self.fleet_cfg.auto_export_aot
+                    and eng.compiled_keys()):
+                try:
+                    eng.export_aot(self.aot_dir)
+                except Exception as e:  # export is an optimization,
+                    self._sink.emit(     # never a bring-up failure
+                        "aot_export_error", error=str(e)[:300])
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="raft-fleet-supervisor",
+            daemon=True)
+        self._supervisor.start()
+        self._sink.emit("fleet_start", replicas=len(self.replicas),
+                        aot_dir=self.aot_dir)
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop supervision, any in-progress warming engine, and every
+        replica (optionally draining in-flight work).  Safe to call
+        while a weight update's warmup is mid-flight: the warming
+        engine is stopped and the update fails cleanly."""
+        self._stop_event.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=30)
+            self._supervisor = None
+        warming = self._warming
+        if warming is not None:
+            try:
+                warming.stop(drain=False, timeout=10)
+            except Exception:
+                pass
+        for r in self.replicas:
+            eng = r.engine
+            r.set_state("stopped")
+            if eng is not None:
+                eng.stop(drain=drain, timeout=timeout)
+        self._sink.emit("fleet_stop")
+
+    def __enter__(self) -> "ReplicaFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------
+    # supervision
+    # ------------------------------------------------------------------
+
+    def _supervise(self) -> None:
+        poll = self.fleet_cfg.health_poll_s
+        while not self._stop_event.wait(poll):
+            for r in self.replicas:
+                if self._stop_event.is_set():
+                    return
+                if r.state != "ready":
+                    continue
+                eng = r.engine
+                if eng is None:
+                    continue
+                if eng.crashed:
+                    self._restart(r, "crash")
+                elif eng.health()["stalled"]:
+                    self._restart(r, "stall")
+                elif (r.backoff_level
+                      and r.ready_since is not None
+                      and time.monotonic() - r.ready_since
+                      > self.fleet_cfg.backoff_reset_s):
+                    r.backoff_level = 0
+
+    def _backoff(self, level: int) -> float:
+        cfg = self.fleet_cfg
+        base = min(cfg.restart_backoff_s * 2 ** max(level - 1, 0),
+                   cfg.restart_backoff_max_s)
+        return base * (1.0 + cfg.restart_jitter
+                       * float(self._backoff_rng.uniform(-1, 1)))
+
+    def _restart(self, r: Replica, reason: str) -> None:
+        """Stop the dead engine, back off, build + adopt a fresh one
+        (AOT-imported: its first request compiles nothing).  Runs on
+        the supervisor thread; restarts are sequential by design."""
+        r.set_state("restarting")
+        old = r.engine
+        crash_reason = getattr(old, "crashed", None)
+        self._sink.emit("fleet_restart_begin", replica=r.name,
+                        reason=reason, crash=crash_reason)
+        if old is not None:
+            try:
+                # drain=False: a crashed/wedged engine cannot finish its
+                # queue; fail the stragglers fast so the router fails
+                # them over while we rebuild.
+                old.stop(drain=False, timeout=10)
+            except Exception as e:
+                self._sink.emit("fleet_restart_stop_error",
+                                replica=r.name, error=str(e)[:300])
+        while not self._stop_event.is_set():
+            r.backoff_level += 1
+            backoff = self._backoff(r.backoff_level)
+            if self._stop_event.wait(backoff):
+                return
+            try:
+                eng = self._build_engine(replica=r.name)
+                eng.start()
+            except Exception as e:
+                r.restart_failures += 1
+                self._sink.emit("fleet_restart_error", replica=r.name,
+                                attempt=r.restart_failures,
+                                error=f"{type(e).__name__}: "
+                                      f"{str(e)[:300]}")
+                if (r.restart_failures
+                        >= self.fleet_cfg.max_restart_failures):
+                    r.set_state("failed")
+                    self._sink.emit("fleet_replica_failed",
+                                    replica=r.name,
+                                    attempts=r.restart_failures)
+                    return
+                continue
+            r.adopt(eng)
+            r.restarts += 1
+            r.restart_failures = 0
+            r.set_state("ready")
+            self._restarts.inc(replica=r.name, reason=reason)
+            self._sink.emit("fleet_restart", replica=r.name,
+                            reason=reason, restarts=r.restarts,
+                            backoff_s=round(backoff, 4),
+                            aot=dict(eng.aot_info))
+            return
+
+    # ------------------------------------------------------------------
+    # rolling weight updates
+    # ------------------------------------------------------------------
+
+    def update_weights(self, source) -> dict:
+        """Roll the fleet onto new weights with zero downtime.
+
+        ``source`` is a checkpoint directory (bare-pytree or orbax
+        run layout — run layouts are integrity-verified by actually
+        restoring the newest step first) or an in-memory variables
+        pytree.  Gates: verify-ckpt, then a canary inference on the
+        warming engine (finite flow, correct shape).  Only after both
+        pass does any serving replica flip; flips are one replica at a
+        time, atomic per replica, old engine drained.  Raises
+        :class:`WeightUpdateError` at any gate — the fleet keeps
+        serving its current weights."""
+        with self._update_lock:
+            if not self._started or self._stop_event.is_set():
+                raise WeightUpdateError("fleet is not running")
+            t0 = time.perf_counter()
+            warming = None
+            try:
+                new_vars, provenance = self._load_verified(source)
+                warming = self._build_engine(new_vars,
+                                             replica="warming")
+                self._warming = warming
+                warming.start()
+                if self.fleet_cfg.warmup_shapes:
+                    warming.warmup(self.fleet_cfg.warmup_shapes)
+                canary = self._canary(warming)
+            except Exception as e:
+                self._abort_update(warming, e)
+                if isinstance(e, WeightUpdateError):
+                    raise
+                raise WeightUpdateError(
+                    f"weight update aborted before any flip: "
+                    f"{type(e).__name__}: {e}") from e
+            # Commit point: gates passed.  New builds (supervised
+            # restarts racing this update) must pick up the NEW
+            # weights or the fleet would serve two versions forever.
+            with self._var_lock:
+                self._variables = new_vars
+            flipped = []
+            try:
+                for r in self.replicas:
+                    if self._stop_event.is_set():
+                        break
+                    if r.state != "ready":
+                        continue  # supervisor rebuilds it on new vars
+                    if warming is not None:
+                        new_eng, warming = warming, None
+                        self._warming = None
+                        if isinstance(new_eng._sink, _LabeledSink):
+                            new_eng._sink.relabel(replica=r.name)
+                    else:
+                        new_eng = self._build_engine(new_vars,
+                                                     replica=r.name)
+                        new_eng.start()
+                    old = r.adopt(new_eng)
+                    if old is not None:
+                        old.stop(drain=True,
+                                 timeout=self.fleet_cfg.drain_timeout_s)
+                    flipped.append(r.name)
+            except Exception as e:
+                self._abort_update(warming, e, flipped=flipped)
+                raise WeightUpdateError(
+                    f"weight update failed mid-roll (flipped: "
+                    f"{flipped}; unflipped replicas rebuild onto the "
+                    f"new weights on their next restart): "
+                    f"{type(e).__name__}: {e}") from e
+            if warming is not None:  # no ready replica consumed it
+                self._warming = None
+                warming.stop(drain=False, timeout=5)
+            self.weights_version += 1
+            self._weight_updates.inc(ok="true")
+            report = {"ok": True, "version": self.weights_version,
+                      "flipped": flipped, "provenance": provenance,
+                      "canary": canary,
+                      "seconds": round(time.perf_counter() - t0, 3)}
+            self._sink.emit("fleet_weight_update", **report)
+            return report
+
+    def _abort_update(self, warming, exc,
+                      flipped: Optional[list] = None) -> None:
+        self._warming = None
+        if warming is not None:
+            try:
+                warming.stop(drain=False, timeout=5)
+            except Exception:
+                pass
+        self._weight_updates.inc(ok="false")
+        self._sink.emit("fleet_weight_update", ok=False,
+                        flipped=flipped or [],
+                        error=f"{type(exc).__name__}: {str(exc)[:300]}")
+
+    def _load_verified(self, source):
+        """Load + integrity-gate new weights.  Never let a torn write
+        through: for run-layout checkpoints the newest step is restored
+        template-less (``CheckpointManager.verify``) BEFORE the model
+        load; there is deliberately NO fallback to an older step — a
+        silent version downgrade is worse than a refused update."""
+        if isinstance(source, dict):
+            return source, {"kind": "pytree"}
+        path = os.path.abspath(str(source))
+        if not os.path.isdir(path):
+            raise WeightUpdateError(f"checkpoint dir not found: {path}")
+        provenance: dict = {"kind": "dir", "path": path}
+        if not os.path.exists(os.path.join(path, "_METADATA")):
+            from raft_tpu.train.checkpoint import CheckpointManager
+
+            mgr = CheckpointManager(path, async_save=False)
+            try:
+                steps = mgr.all_steps()
+                if not steps:
+                    raise WeightUpdateError(
+                        f"no checkpoint steps under {path}")
+                newest = max(steps)
+                report = mgr.verify(newest)
+                if not report["ok"]:
+                    raise WeightUpdateError(
+                        f"verify-ckpt gate failed for step {newest}: "
+                        f"{report.get('error')}")
+                provenance.update(step=newest, verified=True)
+            finally:
+                mgr.close()
+        try:
+            from raft_tpu.cli.evaluate import load_model_variables
+
+            new_vars = load_model_variables(path)
+        except WeightUpdateError:
+            raise
+        except Exception as e:
+            raise WeightUpdateError(
+                f"failed to load weights from {path}: "
+                f"{type(e).__name__}: {e}") from e
+        return self._conform(new_vars), provenance
+
+    def _conform(self, new_vars):
+        """Align EMPTY-container differences between the loaded tree and
+        the serving tree (checkpoint layouts differ on whether an unused
+        ``batch_stats`` collection exists) so an AOT-covered update
+        keeps its zero-compile warm start — the executable's input
+        pytree must match exactly.  Real structural changes (leaves
+        added/removed) pass through untouched: the AOT fingerprint
+        refuses the artifact and the warming engine falls back to lazy
+        compiles, which is correct, just slower."""
+        import jax
+
+        with self._var_lock:
+            cur = self._variables
+        if not isinstance(new_vars, dict):
+            return new_vars
+        if "batch_stats" in cur and "batch_stats" not in new_vars:
+            return dict(new_vars, batch_stats={})
+        if ("batch_stats" not in cur and "batch_stats" in new_vars
+                and not jax.tree_util.tree_leaves(
+                    new_vars["batch_stats"])):
+            return {k: v for k, v in new_vars.items()
+                    if k != "batch_stats"}
+        return new_vars
+
+    def _canary(self, warming: InferenceEngine) -> dict:
+        """Canary gate: the warming engine must produce finite flow of
+        the right shape on synthetic frames before ANY live replica
+        flips.  (No numeric comparison against the live fleet — the
+        weights are supposed to differ; what must not differ is
+        contract: shape, dtype, finiteness.)"""
+        shapes = (self.fleet_cfg.canary_shapes
+                  or self.fleet_cfg.warmup_shapes or ((64, 96),))
+        rng = np.random.default_rng(0)
+        report = []
+        for (h, w) in shapes:
+            im1 = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+            im2 = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+            try:
+                flow = warming.infer(im1, im2, timeout=300)
+            except Exception as e:
+                raise WeightUpdateError(
+                    f"canary inference failed at {h}x{w}: "
+                    f"{type(e).__name__}: {e}") from e
+            if flow.shape != (h, w, 2):
+                raise WeightUpdateError(
+                    f"canary flow shape {flow.shape} != {(h, w, 2)}")
+            if not np.isfinite(flow).all():
+                raise WeightUpdateError(
+                    f"canary flow at {h}x{w} contains non-finite "
+                    "values — refusing to roll these weights out")
+            report.append({"shape": [h, w],
+                           "flow_abs_mean":
+                               round(float(np.abs(flow).mean()), 4)})
+        return report
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def health(self) -> dict:
+        """Fleet readiness: ready ⇔ at least one replica is eligible
+        for traffic.  Per-replica detail nested under ``replicas``."""
+        reps = {}
+        for r in self.replicas:
+            eng = r.engine
+            h = eng.health() if eng is not None else {"ready": False}
+            reps[r.name] = dict(h, state=r.state, restarts=r.restarts,
+                                generation=r.generation,
+                                breaker_open=r.breaker_open())
+        return {"ready": any(r.eligible() for r in self.replicas),
+                "weights_version": self.weights_version,
+                "replicas": reps}
+
+    def stats(self) -> dict:
+        reps = {}
+        for r in self.replicas:
+            eng = r.engine
+            s = eng.stats() if eng is not None else {}
+            reps[r.name] = dict(s, state=r.state, restarts=r.restarts,
+                                generation=r.generation)
+        return {
+            "fleet": {
+                "replicas": len(self.replicas),
+                "weights_version": self.weights_version,
+                "restarts_total": int(sum(
+                    v for _, v in self._restarts.items())),
+                "aot_dir": self.aot_dir,
+            },
+            "replicas": reps,
+        }
+
+    def metrics_text(self) -> str:
+        """One Prometheus exposition for the whole fleet: fleet-level
+        series (restarts, weight updates, router counters — the router
+        registers on this registry) plus every replica's engine
+        registry with a ``replica`` label merged onto each sample."""
+        parts = [render_metrics(self.registry)]
+        for r in self.replicas:
+            eng = r.engine
+            if eng is not None:
+                parts.append(render_metrics(
+                    eng.registry, extra_labels={"replica": r.name}))
+        return "".join(parts)

@@ -1,0 +1,407 @@
+"""Health-gated request router for a replica fleet (docs/SERVING.md).
+
+The router is the fleet's front door: callers ``submit()`` here instead
+of on an engine, and the router picks WHICH replica runs the request —
+then stands behind it until the future resolves.  The contract that
+matters is the last one: **an accepted request is never silently
+dropped**.  Every accepted future terminates, either with a flow field
+or with the error of its LAST attempt — a replica dying mid-batch costs
+the request a failover hop, not an infinite wait.
+
+Placement policy, in order:
+
+1. **Health gate** — replicas that are not ready (crashed, stalled,
+   stopping, still restarting) or whose circuit breaker is open get NO
+   traffic.  The gate reads the engine's own ``health()`` signal, the
+   same one ``GET /v1/healthz`` serves.
+2. **Bucket affinity** — requests hash by their padded shape bucket
+   (``crc32(bucket) % n``), so each bucket's compiled executables and
+   micro-batching concentrate on one replica instead of smearing
+   compile caches across the fleet.
+3. **Least-loaded fallback** — when the affine replica is ineligible or
+   saturated (pending ≥ 3/4 of ``max_queue``), the least-loaded
+   eligible replica takes the request.
+
+Failure handling:
+
+- **Failover**: a request that fails with a *replica-indicting* error
+  (:func:`is_failover_error` — replica-fatal chaos faults, an engine
+  that stopped/crashed under the request, or a transient device error
+  that out-lived the engine's own retry ladder) is re-dispatched on a
+  sibling, **at most once per replica** (a tried-set, so a poison
+  request that kills every replica fails after N attempts instead of
+  cycling forever).  Deterministic request errors (bad shapes) are
+  returned to the caller unchanged — re-running them elsewhere would
+  only repeat the failure.
+- **Hedging**: with ``hedge_timeout_s > 0``, a request still unresolved
+  after the timeout gets ONE duplicate dispatch on a different replica;
+  first result wins, the loser is ignored (bounded: one hedge per
+  request, never to a replica already tried).  This covers stragglers —
+  the ``replica_slow`` chaos fault — without the 2x load of
+  always-mirror.
+- **Circuit breaker**: ``breaker_threshold`` consecutive failover-class
+  failures open a replica's breaker for ``breaker_cooldown_s`` (no
+  traffic), so a flapping replica can't eat a failover hop from every
+  request while the supervisor gets around to restarting it.
+- **Backpressure**: when no eligible replica can accept (all queues
+  full), ``submit()`` raises :class:`QueueFullError` carrying the
+  fleet-wide queue depth — the HTTP edge turns it into a structured
+  429 with Retry-After.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import zlib
+from concurrent.futures import Future
+from typing import List, Optional, Set
+
+import numpy as np
+
+from raft_tpu.chaos import is_transient_error
+from raft_tpu.obs import EventSink, MetricRegistry
+from raft_tpu.ops.pad import bucket_hw
+from raft_tpu.serve.engine import QueueFullError
+from raft_tpu.serve.stats import LatencyRecorder
+
+
+def is_failover_error(exc: BaseException) -> bool:
+    """True when a request failure indicts the REPLICA rather than the
+    request — worth re-dispatching on a sibling.  Replica-fatal chaos
+    faults carry ``replica_fatal``; an engine that stopped or crashed
+    under an in-flight request raises the lifecycle RuntimeErrors; a
+    transient device error that exhausted the engine's in-replica retry
+    ladder may still succeed on a sibling's device."""
+    if getattr(exc, "replica_fatal", False):
+        return True
+    if isinstance(exc, QueueFullError):
+        return False
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        if ("engine stopped" in msg or "engine crashed" in msg
+                or "engine not started" in msg):
+            return True
+    return is_transient_error(exc)
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Routing knobs (``FlowRouter``)."""
+
+    #: Duplicate a still-unresolved request onto a second replica after
+    #: this many seconds (0 disables hedging).  Bound it well above the
+    #: p99 batch time or the hedge fires on healthy traffic.
+    hedge_timeout_s: float = 0.0
+    #: Consecutive failover-class failures before a replica's breaker
+    #: opens (no traffic until the cooldown passes or the supervisor
+    #: restarts it, which resets the breaker).
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 2.0
+    #: Affinity saturation point: route past the affine replica once its
+    #: pending depth reaches this fraction of ``max_queue``.
+    affinity_spill: float = 0.75
+
+    def __post_init__(self):
+        if self.hedge_timeout_s < 0:
+            raise ValueError("hedge_timeout_s must be >= 0")
+        if self.breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+
+
+class _RoutedRequest:
+    """Book-keeping for one accepted request: the caller-facing future,
+    the replicas tried, and first-wins settlement (primary vs hedge)."""
+
+    __slots__ = ("image1", "image2", "bucket", "future", "tried",
+                 "lock", "hedged", "timer", "t_submit", "last_exc")
+
+    def __init__(self, image1, image2, bucket):
+        self.image1 = image1
+        self.image2 = image2
+        self.bucket = bucket
+        self.future: Future = Future()
+        self.tried: Set[str] = set()
+        self.lock = threading.RLock()
+        self.hedged = False
+        self.timer: Optional[threading.Timer] = None
+        self.t_submit = time.perf_counter()
+        self.last_exc: Optional[BaseException] = None
+
+    def settle_result(self, value) -> bool:
+        with self.lock:
+            if self.future.done():
+                return False
+            self._cancel_timer()
+            self.future.set_result(value)
+            return True
+
+    def settle_exception(self, exc: BaseException) -> bool:
+        with self.lock:
+            if self.future.done():
+                return False
+            self._cancel_timer()
+            self.future.set_exception(exc)
+            return True
+
+    def _cancel_timer(self) -> None:
+        if self.timer is not None:
+            self.timer.cancel()
+            self.timer = None
+
+
+class FlowRouter:
+    """See module docstring.  ``fleet`` is duck-typed: it exposes
+    ``replicas`` (list of :class:`raft_tpu.serve.fleet.Replica`),
+    ``serve_cfg`` and ``registry``."""
+
+    def __init__(self, fleet, cfg: RouterConfig = RouterConfig(), *,
+                 sink: Optional[EventSink] = None):
+        self.fleet = fleet
+        self.cfg = cfg
+        self.registry: MetricRegistry = fleet.registry
+        self._sink = sink if sink is not None else EventSink.from_env()
+        self._requests = self.registry.counter(
+            "raft_fleet_requests_total",
+            "requests dispatched, by target replica")
+        self._failovers = self.registry.counter(
+            "raft_fleet_failovers_total",
+            "requests re-dispatched off a failed replica")
+        self._hedges = self.registry.counter(
+            "raft_fleet_hedges_total", "hedge duplicates dispatched")
+        self._hedge_wins = self.registry.counter(
+            "raft_fleet_hedge_wins_total",
+            "requests whose hedge finished first")
+        self._rejected = self.registry.counter(
+            "raft_fleet_rejected_total",
+            "submissions rejected (no eligible replica could accept)")
+        # Tripwire, asserted == 0 by the chaos drill: incremented only
+        # if a terminal path failed to settle an accepted future (a
+        # router bug, not an operational condition).
+        self._dropped = self.registry.counter(
+            "raft_fleet_dropped_total",
+            "accepted requests that were never settled (must stay 0)")
+        self._latency = LatencyRecorder(
+            registry=self.registry,
+            metric="raft_fleet_request_latency_seconds")
+
+    # ------------------------------------------------------------------
+    # client API (any thread)
+    # ------------------------------------------------------------------
+
+    def submit(self, image1, image2) -> Future:
+        """Route one frame pair; returns a Future resolving to the
+        ``(H, W, 2)`` flow.  Raises :class:`QueueFullError` when no
+        eligible replica can accept, ``RuntimeError`` when the fleet
+        has no live replica at all."""
+        im1 = np.asarray(image1, dtype=np.float32)
+        im2 = np.asarray(image2, dtype=np.float32)
+        if im1.ndim != 3 or im1.shape[-1] != 3 or im1.shape != im2.shape:
+            raise ValueError(
+                f"expected two matching (H, W, 3) images, got "
+                f"{im1.shape} and {im2.shape}")
+        scfg = self.fleet.serve_cfg
+        bucket = bucket_hw(im1.shape[0], im1.shape[1],
+                           scfg.bucket_multiple, scfg.buckets)
+        req = _RoutedRequest(im1, im2, bucket)
+        self._dispatch(req, initial=True)
+        return req.future
+
+    def infer(self, image1, image2,
+              timeout: Optional[float] = None) -> np.ndarray:
+        return self.submit(image1, image2).result(timeout=timeout)
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+
+    def _eligible(self, exclude: Set[str]) -> List[object]:
+        return [r for r in self.fleet.replicas
+                if r.name not in exclude and r.eligible()]
+
+    def _pick(self, bucket: tuple, exclude: Set[str]):
+        """Affinity first, least-loaded fallback, health-gated."""
+        candidates = self._eligible(exclude)
+        if not candidates:
+            return None
+        n = len(self.fleet.replicas)
+        affine_idx = zlib.crc32(repr(bucket).encode()) % n
+        scfg = self.fleet.serve_cfg
+        spill = self.cfg.affinity_spill * scfg.max_queue
+        for r in candidates:
+            if r.index == affine_idx and r.pending() < spill:
+                return r
+        return min(candidates, key=lambda r: (r.pending(), r.index))
+
+    # ------------------------------------------------------------------
+    # dispatch / failover / hedging
+    # ------------------------------------------------------------------
+
+    def _dispatch(self, req: _RoutedRequest, *, initial: bool) -> None:
+        """Hand ``req`` to an eligible replica, walking the eligibility
+        list until one accepts.  Terminal failures raise synchronously
+        on the initial dispatch (the caller hasn't been handed a future
+        yet) and settle the future afterwards — the request is never
+        left pending."""
+        saw_full = None
+        while True:
+            with req.lock:
+                if req.future.done():
+                    return
+                replica = self._pick(req.bucket, req.tried)
+                if replica is not None:
+                    req.tried.add(replica.name)
+            if replica is None:
+                self._terminal(req, saw_full, initial)
+                return
+            try:
+                inner = replica.engine.submit(req.image1, req.image2)
+            except QueueFullError as e:
+                saw_full = e  # full ≠ dead: no breaker strike
+                continue
+            except RuntimeError as e:
+                # Lost the race with a crash/stop between the health
+                # check and submit — treat exactly like a failed
+                # attempt on that replica.
+                if not is_failover_error(e):
+                    self._settle_or_raise(req, e, initial)
+                    return
+                req.last_exc = e
+                replica.note_failure(self.cfg.breaker_threshold,
+                                     self.cfg.breaker_cooldown_s)
+                continue
+            self._requests.inc(replica=replica.name)
+            if initial:
+                self._maybe_arm_hedge(req)
+            gen = replica.generation
+            inner.add_done_callback(
+                lambda f, r=replica, g=gen: self._on_done(req, r, g, f))
+            return
+
+    def _terminal(self, req: _RoutedRequest, saw_full, initial: bool):
+        """No replica left to try: fail the request loudly."""
+        if saw_full is not None:
+            depth = sum(r.pending() for r in self.fleet.replicas)
+            exc: BaseException = QueueFullError(
+                f"all eligible replicas at max_queue "
+                f"({depth} requests in flight fleet-wide); retry after "
+                f"{self.fleet.serve_cfg.retry_after_s:g}s",
+                queue_depth=depth,
+                retry_after_s=self.fleet.serve_cfg.retry_after_s)
+            self._rejected.inc()
+        elif req.last_exc is not None:
+            exc = RuntimeError(
+                f"request failed on {len(req.tried)} replica(s); "
+                f"last error: {type(req.last_exc).__name__}: "
+                f"{req.last_exc}")
+            exc.__cause__ = req.last_exc
+        else:
+            states = {r.name: r.state for r in self.fleet.replicas}
+            exc = RuntimeError(
+                f"no eligible replica (fleet states: {states})")
+            self._rejected.inc()
+        self._settle_or_raise(req, exc, initial)
+
+    def _settle_or_raise(self, req: _RoutedRequest, exc: BaseException,
+                         initial: bool) -> None:
+        if initial:
+            req._cancel_timer()
+            raise exc
+        if not req.settle_exception(exc) and not req.future.done():
+            # Unreachable by construction; the tripwire exists so a
+            # future regression shows up as a nonzero counter in the
+            # drill instead of a hung client.
+            self._dropped.inc()
+
+    def _maybe_arm_hedge(self, req: _RoutedRequest) -> None:
+        if self.cfg.hedge_timeout_s <= 0 or len(self.fleet.replicas) < 2:
+            return
+        timer = threading.Timer(self.cfg.hedge_timeout_s,
+                                self._hedge, args=(req,))
+        timer.daemon = True
+        req.timer = timer
+        timer.start()
+
+    def _hedge(self, req: _RoutedRequest) -> None:
+        with req.lock:
+            if req.future.done() or req.hedged:
+                return
+            req.hedged = True
+            replica = self._pick(req.bucket, req.tried)
+            if replica is None:
+                return  # nowhere to hedge; primary still owns the request
+            req.tried.add(replica.name)
+        try:
+            inner = replica.engine.submit(req.image1, req.image2)
+        except Exception:
+            return  # hedge is best-effort; the primary attempt stands
+        self._hedges.inc()
+        self._requests.inc(replica=replica.name)
+        self._sink.emit("serve_hedge", replica=replica.name,
+                        bucket=f"{req.bucket[0]}x{req.bucket[1]}")
+        gen = replica.generation
+        inner.add_done_callback(
+            lambda f, r=replica, g=gen: self._on_done(req, r, g, f,
+                                                      hedge=True))
+
+    def _on_done(self, req: _RoutedRequest, replica, generation: int,
+                 inner: Future, *, hedge: bool = False) -> None:
+        exc = inner.exception()
+        if exc is None:
+            replica.note_success()
+            if req.settle_result(inner.result()):
+                self._latency.record(
+                    time.perf_counter() - req.t_submit)
+                if hedge:
+                    self._hedge_wins.inc()
+            return
+        if is_failover_error(exc):
+            # Strike the replica only if this failure came from the
+            # engine generation we dispatched to (a restarted engine
+            # must not inherit its predecessor's strikes).
+            if replica.generation == generation:
+                replica.note_failure(self.cfg.breaker_threshold,
+                                     self.cfg.breaker_cooldown_s)
+            req.last_exc = exc
+            if not req.future.done():
+                self._failovers.inc(replica=replica.name)
+                self._sink.emit(
+                    "serve_failover", replica=replica.name,
+                    bucket=f"{req.bucket[0]}x{req.bucket[1]}",
+                    tried=len(req.tried),
+                    error=f"{type(exc).__name__}: {str(exc)[:200]}")
+                self._dispatch(req, initial=False)
+            return
+        req.settle_exception(exc)
+
+    # ------------------------------------------------------------------
+    # introspection (the HTTP edge serves a router exactly like a bare
+    # engine: same health/stats/metrics_text facade)
+    # ------------------------------------------------------------------
+
+    def health(self) -> dict:
+        return self.fleet.health()
+
+    def metrics_text(self) -> str:
+        return self.fleet.metrics_text()
+
+    def router_stats(self) -> dict:
+        def total(counter):  # sum across label sets (per-replica lines)
+            return sum(v for _, v in counter.items())
+
+        return {
+            "requests_total": total(self._requests),
+            "requests_by_replica": {
+                dict(k).get("replica", ""): v
+                for k, v in self._requests.items()},
+            "failovers_total": total(self._failovers),
+            "hedges_total": self._hedges.value(),
+            "hedge_wins_total": self._hedge_wins.value(),
+            "rejected_total": self._rejected.value(),
+            "dropped_total": self._dropped.value(),
+            "latency_ms": self._latency.snapshot(),
+        }
+
+    def stats(self) -> dict:
+        return dict(self.fleet.stats(), router=self.router_stats())

@@ -17,11 +17,21 @@ Two canonical load shapes:
   loop keeps arriving while the server falls behind; a closed loop
   politely waits and hides the collapse).
 
+``--replicas N`` (N > 1) drives a ``ReplicaFleet`` behind the
+``FlowRouter`` instead of a bare engine (optionally with
+``--hedge-timeout-s``); the record gains per-replica engine sections
+and the router counters.  Every record carries ``errors`` /
+``timeouts`` (per ``--request-timeout-s``) / ``error_rate`` (failures
+over submitted, 429 sheds excluded) and ``retries_total`` so
+``scripts/check_regression.py --max-serve-error-rate`` can gate the
+series — a fleet that posts throughput while losing requests fails.
+
 ``--tiny``: CPU-friendly smoke preset (small model, fp32, 2 iters, two
 tiny resolutions) so the serving path stays testable without hardware::
 
     JAX_PLATFORMS=cpu python scripts/bench_serve.py --tiny
     JAX_PLATFORMS=cpu python scripts/bench_serve.py --tiny --mode open
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --tiny --replicas 2
 
 There is no external serving baseline (the reference repo has no request
 path at all); ``vs_baseline`` is 0.0 until a measured TPU number lands
@@ -67,6 +77,15 @@ def parse_args(argv=None):
     p.add_argument("--no-warmup", action="store_true",
                    help="include first-request compiles in the "
                         "measurement (cold-start experiment)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="drive a supervised replica fleet behind the "
+                        "health-gated router instead of one engine "
+                        "(docs/SERVING.md fleet section)")
+    p.add_argument("--hedge-timeout-s", type=float, default=0.0,
+                   help="fleet mode: router hedge timeout (0 = off)")
+    p.add_argument("--request-timeout-s", type=float, default=120.0,
+                   help="per-request wait bound; expiries count in the "
+                        "'timeouts' figure instead of hanging the bench")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
     if args.tiny:
@@ -84,8 +103,39 @@ def parse_args(argv=None):
     return args
 
 
-def _run_closed(engine, pairs, n_requests, concurrency):
+class _Outcomes:
+    """Thread-safe request-outcome tally: a request either completes,
+    is rejected at submit (429 shed — intentional, NOT an error), fails
+    with an error, or times out client-side."""
+
+    def __init__(self, timeout_s):
+        self.timeout_s = timeout_s
+        self.lock = threading.Lock()
+        self.completed = 0
+        self.rejected = 0
+        self.errors = 0
+        self.timeouts = 0
+
+    def wait(self, fut) -> None:
+        from concurrent.futures import TimeoutError as FutTimeout
+
+        try:
+            fut.result(timeout=self.timeout_s)
+        except FutTimeout:
+            with self.lock:
+                self.timeouts += 1
+        except Exception:
+            with self.lock:
+                self.errors += 1
+        else:
+            with self.lock:
+                self.completed += 1
+
+
+def _run_closed(engine, pairs, n_requests, concurrency, out: "_Outcomes"):
     """Each worker keeps one request in flight; returns elapsed seconds."""
+    from raft_tpu.serve import QueueFullError
+
     next_i = [0]
     lock = threading.Lock()
 
@@ -97,7 +147,13 @@ def _run_closed(engine, pairs, n_requests, concurrency):
                     return
                 next_i[0] += 1
             im1, im2 = pairs[i % len(pairs)]
-            engine.infer(im1, im2)
+            try:
+                fut = engine.submit(im1, im2)
+            except QueueFullError:
+                with out.lock:
+                    out.rejected += 1
+                continue
+            out.wait(fut)
 
     threads = [threading.Thread(target=worker) for _ in range(concurrency)]
     t0 = time.perf_counter()
@@ -105,18 +161,18 @@ def _run_closed(engine, pairs, n_requests, concurrency):
         t.start()
     for t in threads:
         t.join()
-    return time.perf_counter() - t0, 0
+    return time.perf_counter() - t0
 
 
-def _run_open(engine, pairs, n_requests, rate, rng):
-    """Poisson arrivals at ``rate`` req/s; returns (elapsed, rejected).
+def _run_open(engine, pairs, n_requests, rate, rng, out: "_Outcomes"):
+    """Poisson arrivals at ``rate`` req/s; returns elapsed seconds.
 
     Arrivals keep coming while earlier requests run — rejected submits
     (429 backpressure) are counted, not retried (a shed request's work
     is the balancer's problem, not this chip's)."""
     from raft_tpu.serve import QueueFullError
 
-    futures, rejected = [], 0
+    futures = []
     t0 = time.perf_counter()
     for i in range(n_requests):
         time.sleep(rng.exponential(1.0 / rate))
@@ -124,10 +180,11 @@ def _run_open(engine, pairs, n_requests, rate, rng):
         try:
             futures.append(engine.submit(im1, im2))
         except QueueFullError:
-            rejected += 1
+            with out.lock:
+                out.rejected += 1
     for f in futures:
-        f.result()
-    return time.perf_counter() - t0, rejected
+        out.wait(f)
+    return time.perf_counter() - t0
 
 
 def main(argv=None):
@@ -164,39 +221,83 @@ def main(argv=None):
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
         batch_sizes=tuple(int(b) for b in args.batch_sizes.split(","))
         if args.batch_sizes else None)
-    engine = InferenceEngine(variables, model_cfg, serve_cfg)
-    engine.start()
+    fleet = None
+    if args.replicas > 1:
+        from raft_tpu.serve import (FleetConfig, FlowRouter,
+                                    ReplicaFleet, RouterConfig)
+
+        fleet = ReplicaFleet(
+            variables, model_cfg, serve_cfg,
+            FleetConfig(replicas=args.replicas,
+                        warmup_shapes=() if args.no_warmup
+                        else tuple(shapes)))
+        fleet.start()
+        service = FlowRouter(fleet, RouterConfig(
+            hedge_timeout_s=max(args.hedge_timeout_s, 0.0)))
+    else:
+        service = InferenceEngine(variables, model_cfg, serve_cfg)
+        service.start()
+    out = _Outcomes(args.request_timeout_s or None)
     try:
-        if not args.no_warmup:
-            engine.warmup(shapes)
+        if not args.no_warmup and fleet is None:
+            service.warmup(shapes)
         if args.mode == "closed":
             assert args.concurrency <= args.max_queue, \
                 "closed loop would trip its own backpressure"
-            dt, rejected = _run_closed(engine, pairs, args.requests,
-                                       args.concurrency)
+            dt = _run_closed(service, pairs, args.requests,
+                             args.concurrency, out)
         else:
-            dt, rejected = _run_open(engine, pairs, args.requests,
-                                     args.rate, rng)
-        stats = engine.stats()
+            dt = _run_open(service, pairs, args.requests, args.rate,
+                           rng, out)
+        stats = service.stats()
     finally:
-        engine.stop()
+        if fleet is not None:
+            fleet.stop()
+        else:
+            service.stop()
 
     n_dev = max(jax.local_device_count(), 1)
-    completed = args.requests - rejected
-    pairs_per_sec_per_chip = completed / dt / n_dev
+    pairs_per_sec_per_chip = out.completed / dt / n_dev
+    # error_rate covers FAILED requests (errors + client timeouts) over
+    # everything submitted; 429 sheds are intentional backpressure and
+    # stay a separate figure (check_regression gates on error_rate).
+    error_rate = (out.errors + out.timeouts) / max(args.requests, 1)
+    if fleet is not None:
+        per_replica = {
+            name: {"retries": rep.get("retries", 0),
+                   "completed": rep.get("completed", 0),
+                   "restarts": rep.get("restarts", 0)}
+            for name, rep in stats["replicas"].items()}
+        retries_total = sum(r["retries"] for r in per_replica.values())
+        latency = stats["router"]["latency_ms"]
+        occupancy = None
+        compiles = {name: rep.get("compiles", {})
+                    for name, rep in stats["replicas"].items()}
+    else:
+        per_replica = None
+        retries_total = stats["retries"]
+        latency = stats["latency_ms"]
+        occupancy = stats["occupancy"]
+        compiles = stats["compiles"]
     tag = "tiny" if args.tiny else "+".join(f"{h}x{w}"
                                             for (h, w) in shapes)
     load = (f"c{args.concurrency}" if args.mode == "closed"
             else f"r{args.rate:g}")
-    print(json.dumps({
-        "metric": f"serve_{args.mode}loop_{tag}_{load}_iters{args.iters}",
+    rep_tag = f"_x{args.replicas}" if args.replicas > 1 else ""
+    record = {
+        "metric": f"serve_{args.mode}loop_{tag}_{load}"
+                  f"_iters{args.iters}{rep_tag}",
         "value": round(pairs_per_sec_per_chip, 3),
         "unit": "image-pairs/sec/chip",
         "vs_baseline": 0.0,
-        "latency_ms": stats["latency_ms"],
-        "rejected": rejected,
-        "occupancy": stats["occupancy"],
-        "compiles": stats["compiles"],
+        "latency_ms": latency,
+        "rejected": out.rejected,
+        "errors": out.errors,
+        "timeouts": out.timeouts,
+        "error_rate": round(error_rate, 6),
+        "retries_total": retries_total,
+        "occupancy": occupancy,
+        "compiles": compiles,
         "config": {"mode": args.mode, "requests": args.requests,
                    "concurrency": args.concurrency, "rate": args.rate,
                    "shapes": args.shapes, "iters": args.iters,
@@ -205,8 +306,17 @@ def main(argv=None):
                    "max_queue": args.max_queue,
                    "batch_sizes": args.batch_sizes,
                    "warmup": not args.no_warmup,
+                   "replicas": args.replicas,
                    "precision": args.precision, "small": args.small},
-    }))
+    }
+    if per_replica is not None:
+        record["replicas"] = per_replica
+        record["router"] = {
+            k: stats["router"][k]
+            for k in ("requests_total", "failovers_total", "hedges_total",
+                      "hedge_wins_total", "rejected_total",
+                      "dropped_total")}
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -71,6 +71,12 @@ def parse_args(argv=None):
                    help="fail when a newest record's "
                         "config.ckpt_fallback_total exceeds this "
                         "(torn-checkpoint gate)")
+    p.add_argument("--max-serve-error-rate", type=float, default=0.0,
+                   help="fail when a newest serve record's error_rate "
+                        "(failed + timed-out requests over submitted; "
+                        "429 sheds excluded) exceeds this fraction — "
+                        "a fleet drill that dropped requests must not "
+                        "pass on throughput alone")
     p.add_argument("--require-tuned", action="store_true",
                    help="fail when a newest record's config lacks "
                         "`tuned: true` — i.e. its knobs did NOT come "
@@ -110,7 +116,8 @@ def build_series(paths):
 
 
 def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
-          max_quarantined=0, max_ckpt_fallback=0, require_tuned=False):
+          max_quarantined=0, max_ckpt_fallback=0, require_tuned=False,
+          max_serve_error_rate=0.0):
     """``(failures, report)`` over the newest record of each metric."""
     failures, report = [], []
     for metric, recs in sorted(series.items()):
@@ -145,6 +152,16 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
                 f"{metric}: ckpt_fallback_total={int(fb)} > "
                 f"{max_ckpt_fallback} — resume skipped torn "
                 "checkpoint step(s)")
+        # Serve-path gate: bench_serve records carry error_rate (failed
+        # + timed-out requests over submitted; 429 sheds excluded).  A
+        # fleet whose failover quietly loses requests still posts good
+        # throughput — this is the gate that notices.
+        er = newest.get("error_rate")
+        if isinstance(er, (int, float)) and er > max_serve_error_rate:
+            failures.append(
+                f"{metric}: error_rate={er:g} > {max_serve_error_rate:g}"
+                f" ({newest.get('errors', '?')} errors, "
+                f"{newest.get('timeouts', '?')} timeouts)")
         if value is None:
             entry["skipped"] = "value null (backend unavailable)"
             report.append(entry)
@@ -176,7 +193,7 @@ def _selftest() -> int:
     file-loading path."""
 
     def run(values, nonfinite_last=0, drop_pct=10.0, last_cfg=None,
-            **gate_kw):
+            last_top=None, **gate_kw):
         with tempfile.TemporaryDirectory() as td:
             paths = []
             for i, v in enumerate(values):
@@ -188,6 +205,7 @@ def _selftest() -> int:
                         rec["config"]["nonfinite_steps_total"] = \
                             nonfinite_last
                     rec["config"].update(last_cfg or {})
+                    rec.update(last_top or {})
                 if i % 2:  # alternate raw and driver-wrapped envelopes
                     rec = {"n": i, "rc": 0, "parsed": rec}
                 p = os.path.join(td, f"BENCH_r{i:02d}.json")
@@ -224,6 +242,20 @@ def _selftest() -> int:
              require_tuned=True), False),
         ("untuned passes without the gate",
          run([30.0, 31.0, 30.5], last_cfg={"tuned": False}), False),
+        ("serve error_rate fails",
+         run([30.0, 31.0, 30.5],
+             last_top={"error_rate": 0.125, "errors": 2, "timeouts": 1}),
+         True),
+        ("serve error_rate within budget passes",
+         run([30.0, 31.0, 30.5], last_top={"error_rate": 0.125},
+             max_serve_error_rate=0.2), False),
+        ("zero error_rate passes",
+         run([30.0, 31.0, 30.5],
+             last_top={"error_rate": 0.0, "errors": 0, "timeouts": 0}),
+         False),
+        ("rejected-only record passes",
+         run([30.0, 31.0, 30.5],
+             last_top={"error_rate": 0.0, "rejected": 5}), False),
     ]
     bad = [name for name, (failures, _), want_fail in cases
            if bool(failures) != want_fail]
@@ -252,7 +284,8 @@ def main(argv=None):
                              min_vs_baseline=args.min_vs_baseline,
                              max_quarantined=args.max_quarantined,
                              max_ckpt_fallback=args.max_ckpt_fallback,
-                             require_tuned=args.require_tuned)
+                             require_tuned=args.require_tuned,
+                             max_serve_error_rate=args.max_serve_error_rate)
     print(json.dumps({"ok": not failures, "failures": failures,
                       "checked": report}))
     if failures:

@@ -345,3 +345,174 @@ def test_serve_config_validation():
     assert ServeConfig(max_batch=8).resolved_batch_sizes() == (1, 2, 4, 8)
     assert ServeConfig(max_batch=6).resolved_batch_sizes() == (1, 2, 4, 6)
     assert ServeConfig(batch_sizes=(4, 2)).resolved_batch_sizes() == (2, 4)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle edges, retry backoff ladder, structured 429
+# ---------------------------------------------------------------------------
+
+
+class _RecordingSink:
+    """EventSink stand-in: collects (event, fields) for assertions."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, step=None, **fields):
+        self.events.append((event, fields))
+
+    def of(self, event):
+        return [f for e, f in self.events if e == event]
+
+
+class _FlakyDeviceError(RuntimeError):
+    transient = True  # is_transient_error honors the explicit flag
+
+
+def test_submit_after_stop_fails_fast_and_engine_is_single_use(
+        variables):
+    """Engines are single-use: after ``stop()`` a submit fails
+    IMMEDIATELY with an unambiguous error (not the generic not-started
+    one, and never a hang on a dead loop), ``start()`` refuses to
+    resurrect the carcass, and a second ``stop()`` is a no-op.  The
+    fleet supervisor leans on exactly these semantics when it swaps a
+    restarted engine in."""
+    rng = np.random.default_rng(7)
+    im1, im2 = _images(rng, 36, 52)
+
+    # never-started engine: stop() is legal and marks it used up
+    eng = InferenceEngine(variables, CFG, ServeConfig(iters=ITERS))
+    with pytest.raises(RuntimeError, match="not started"):
+        eng.submit(im1, im2)
+    eng.stop()
+    eng.stop()  # idempotent
+    with pytest.raises(RuntimeError, match="single-use"):
+        eng.submit(im1, im2)
+    with pytest.raises(RuntimeError, match="single-use"):
+        eng.start()
+    assert eng.health()["ready"] is False
+
+    # started-then-stopped engine: same contract after a real lifecycle
+    eng2 = InferenceEngine(variables, CFG, ServeConfig(iters=ITERS))
+    eng2.start()
+    eng2.stop(drain=True, timeout=5)
+    with pytest.raises(RuntimeError, match="single-use"):
+        eng2.submit(im1, im2)
+    with pytest.raises(RuntimeError, match="single-use"):
+        eng2.start()
+
+
+def test_queue_full_error_carries_backoff_hints():
+    e = QueueFullError("full", queue_depth=7, retry_after_s=2.0)
+    assert e.queue_depth == 7 and e.retry_after_s == 2.0
+    assert isinstance(e, RuntimeError)
+    d = QueueFullError("bare")  # defaults keep old call sites valid
+    assert d.queue_depth == 0 and d.retry_after_s == 1.0
+
+
+def test_call_device_exponential_backoff_schedule(variables):
+    """The retry ladder doubles from ``retry_backoff_s`` and caps at
+    ``retry_backoff_max_s``; with jitter off the ``serve_retry`` events
+    record the exact schedule (chaos drills replay these)."""
+    sink = _RecordingSink()
+    eng = InferenceEngine(variables, CFG, ServeConfig(
+        iters=ITERS, device_retries=3, retry_backoff_s=0.01,
+        retry_backoff_max_s=0.02, retry_jitter=0.0,
+        retry_deadline_s=10.0), sink=sink)
+    calls = {"n": 0}
+
+    def flaky_exe(v, a1, a2):
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            raise _FlakyDeviceError(f"flaky dispatch #{calls['n']}")
+        return None, np.zeros((1, 40, 56, 2), np.float32)
+
+    out = eng._call_device(flaky_exe, np.zeros((1, 40, 56, 3)),
+                          np.zeros((1, 40, 56, 3)), (40, 56), 1)
+    assert out.shape == (1, 40, 56, 2) and calls["n"] == 4
+    retries = sink.of("serve_retry")
+    # 0.01 -> 0.02 -> 0.04 capped at 0.02; attempts numbered from 1
+    assert [r["backoff_s"] for r in retries] == [0.01, 0.02, 0.02]
+    assert [r["attempt"] for r in retries] == [1, 2, 3]
+    assert all(r["elapsed_s"] >= 0 for r in retries)
+    assert eng.stats()["retries"] == 3
+
+
+def test_call_device_jitter_stays_within_band(variables):
+    """With jitter on, each recorded backoff lands inside the
+    ±``retry_jitter`` band around the deterministic ladder value."""
+    sink = _RecordingSink()
+    eng = InferenceEngine(variables, CFG, ServeConfig(
+        iters=ITERS, device_retries=2, retry_backoff_s=0.01,
+        retry_backoff_max_s=0.02, retry_jitter=0.25,
+        retry_deadline_s=10.0), sink=sink)
+
+    def always_flaky(v, a1, a2):
+        raise _FlakyDeviceError("flaky dispatch")
+
+    with pytest.raises(_FlakyDeviceError):
+        eng._call_device(always_flaky, np.zeros((1, 40, 56, 3)),
+                         np.zeros((1, 40, 56, 3)), (40, 56), 1)
+    bands = [(0.01, 1), (0.02, 2)]  # (ladder base, attempt)
+    retries = sink.of("serve_retry")
+    assert len(retries) == 2
+    for rec, (base, attempt) in zip(retries, bands):
+        assert rec["attempt"] == attempt
+        assert 0.75 * base <= rec["backoff_s"] <= 1.25 * base
+
+
+def test_call_device_retry_deadline_caps_the_ladder(variables):
+    """When the next sleep would cross ``retry_deadline_s`` the engine
+    gives up with the ORIGINAL error and records the abandonment as a
+    ``serve_retry_deadline`` event instead of a ``serve_retry``."""
+    sink = _RecordingSink()
+    eng = InferenceEngine(variables, CFG, ServeConfig(
+        iters=ITERS, device_retries=10, retry_backoff_s=0.4,
+        retry_jitter=0.0, retry_deadline_s=0.01), sink=sink)
+
+    def always_flaky(v, a1, a2):
+        raise _FlakyDeviceError("still flaky")
+
+    with pytest.raises(_FlakyDeviceError, match="still flaky"):
+        eng._call_device(always_flaky, np.zeros((1, 40, 56, 3)),
+                         np.zeros((1, 40, 56, 3)), (40, 56), 1)
+    assert sink.of("serve_retry") == []  # never slept once
+    deadline = sink.of("serve_retry_deadline")
+    assert len(deadline) == 1 and deadline[0]["attempt"] == 1
+    assert deadline[0]["deadline_s"] == 0.01
+
+
+def test_http_429_is_structured(variables):
+    """The shed-load response is machine-readable: standard
+    ``Retry-After`` header (delta-seconds, ceiled) plus a JSON body
+    with the queue depth and the raw float hint.  Exercised through the
+    real handler with a facade whose queue is 'full'."""
+    from raft_tpu.cli.serve import make_server
+
+    class _FullService:
+        def infer(self, im1, im2, timeout=None):
+            raise QueueFullError("queue full: 7 in flight",
+                                 queue_depth=7, retry_after_s=1.5)
+
+    server = make_server(_FullService(), "127.0.0.1", 0)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        rng = np.random.default_rng(8)
+        im1, im2 = _images(rng, 36, 52)
+        buf = io.BytesIO()
+        np.savez(buf, image1=im1, image2=im2)
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/flow", data=buf.getvalue(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 429
+        assert ei.value.headers["Retry-After"] == "2"  # ceil(1.5)
+        body = json.loads(ei.value.read())
+        assert body["queue_depth"] == 7
+        assert body["retry_after_s"] == 1.5
+        assert "queue full" in body["error"]
+    finally:
+        server.shutdown()
+        server.server_close()
