@@ -30,6 +30,20 @@ label per sample.
   since the last completed device batch) when requests are pending but
   no batch has completed within ``--stall-timeout-s`` — the serve-side
   stall signal a balancer should drain on.
+- ``POST /debug/profile?seconds=S``  on-demand device profiling: runs a
+  ``jax.profiler`` capture for S seconds (clamped to [0.05, 60]; one at
+  a time — concurrent requests get 409) into
+  ``<telemetry_dir>/xprof/serve-<ts>/`` and returns the artifact dir.
+  Trace spans recorded during the capture carry an ``xprof=<dir>``
+  attribute linking waterfall to device profile.
+
+Distributed tracing (docs/OBSERVABILITY.md): with
+``--trace-sample-rate`` > 0, each ``POST /v1/flow`` opens (or, given an
+``X-Raft-Trace: <trace>-<span>-<s|d>`` request header, continues) a
+trace whose tree spans router placement, hedging, failover, and the
+device batch; the response echoes the ``X-Raft-Trace`` header so
+callers can correlate.  ``scripts/trace_report.py`` reconstructs the
+trees from the telemetry dir.
 
 Example client::
 
@@ -130,6 +144,11 @@ def parse_args(argv=None):
                         "request onto a second replica after this many "
                         "seconds (0 = hedging off; set well above p99 "
                         "batch time)")
+    p.add_argument("--trace-sample-rate", type=float, default=None,
+                   help="distributed-tracing head-sample rate in [0, 1] "
+                        "(0/unset = tracing off; errors, retries, and "
+                        "hedges are tail-kept regardless once > 0); "
+                        "default $RAFT_TRACE_SAMPLE_RATE")
     return p.parse_args(argv)
 
 
@@ -145,12 +164,18 @@ def _make_handler(engine):
     # ``engine`` is a serving facade: a bare InferenceEngine or a
     # fleet's FlowRouter — both expose infer/health/stats/metrics_text
     # (and raise the same QueueFullError), so one handler serves both.
+    import threading
+
     from http.server import BaseHTTPRequestHandler
 
+    from raft_tpu.obs import trace
     from raft_tpu.serve import QueueFullError
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # One jax.profiler capture at a time (class-level: shared by
+        # every handler thread of this server).
+        _profile_lock = threading.Lock()
 
         def log_message(self, fmt, *args):  # stats() is the signal;
             pass                            # per-request stderr is noise
@@ -188,6 +213,9 @@ def _make_handler(engine):
         def do_POST(self):
             import numpy as np
 
+            if self.path.startswith("/debug/profile"):
+                self._profile()
+                return
             if self.path != "/v1/flow":
                 self._reply_json(404, {"error": f"no route {self.path}"})
                 return
@@ -198,9 +226,28 @@ def _make_handler(engine):
             except Exception as e:
                 self._reply_json(400, {"error": f"bad npz body: {e}"})
                 return
+            # Wire propagation: continue an upstream trace from the
+            # X-Raft-Trace header (their sampling verdict wins), or
+            # open a fresh root; the response echoes the header so the
+            # caller can correlate.  Tracing off = the no-op singleton.
+            tracer = trace.default_tracer()
+            root = trace.NOOP_SPAN
+            if tracer.enabled:
+                up = trace.parse_header(self.headers.get(trace.HEADER))
+                if up is not None:
+                    root = tracer.start_trace(
+                        "serve_http", trace_id=up[0], parent_id=up[1],
+                        sampled=up[2], path=self.path)
+                else:
+                    root = tracer.start_trace("serve_http",
+                                              path=self.path)
+            hdr = trace.format_header(root)
+            thdr = [(trace.HEADER, hdr)] if hdr else []
             try:
-                flow = engine.infer(im1, im2)
+                with trace.use_context(root):
+                    flow = engine.infer(im1, im2)
             except QueueFullError as e:
+                root.end(status="full", error="QueueFullError")
                 # Structured shed-load response: the client gets the
                 # machine-readable backoff hint both as the standard
                 # header (delta-seconds, so ceil) and in the body.
@@ -210,14 +257,74 @@ def _make_handler(engine):
                           "queue_depth": int(getattr(e, "queue_depth", 0)),
                           "retry_after_s": retry_s},
                     extra=[("Retry-After",
-                            str(max(1, math.ceil(retry_s))))])
+                            str(max(1, math.ceil(retry_s))))] + thdr)
                 return
             except ValueError as e:
-                self._reply_json(400, {"error": str(e)})
+                root.end(status="error", error="ValueError")
+                self._reply_json(400, {"error": str(e)}, extra=thdr)
                 return
+            except Exception as e:
+                root.end(status="error", error=type(e).__name__)
+                self._reply_json(
+                    500, {"error": f"{type(e).__name__}: {e}"},
+                    extra=thdr)
+                return
+            root.end(status="ok")
             buf = io.BytesIO()
             np.savez(buf, flow=flow)
-            self._reply(200, buf.getvalue(), "application/octet-stream")
+            self._reply(200, buf.getvalue(), "application/octet-stream",
+                        extra=thdr)
+
+        def _profile(self):
+            """POST /debug/profile?seconds=S — on-demand jax.profiler
+            capture into <telemetry>/xprof/serve-<ts>/ (409 while one
+            is already running; spans recorded during the capture link
+            to it via their xprof attribute)."""
+            import os
+            import tempfile
+            import time
+            from urllib.parse import parse_qs, urlparse
+
+            qs = parse_qs(urlparse(self.path).query)
+            try:
+                seconds = float(qs.get("seconds", ["2"])[0])
+            except ValueError:
+                self._reply_json(400,
+                                 {"error": "seconds must be a number"})
+                return
+            seconds = min(max(seconds, 0.05), 60.0)
+            if not Handler._profile_lock.acquire(blocking=False):
+                self._reply_json(
+                    409, {"error": "a profile capture is already "
+                                   "running; retry when it finishes"})
+                return
+            try:
+                import jax
+
+                from raft_tpu.obs import default_sink
+
+                sink = default_sink()
+                base = sink.directory if sink.enabled else \
+                    tempfile.mkdtemp(prefix="raft-xprof-")
+                outdir = os.path.join(
+                    base, "xprof", time.strftime("serve-%Y%m%d-%H%M%S"))
+                os.makedirs(outdir, exist_ok=True)
+                jax.profiler.start_trace(outdir)
+                trace.set_active_profile(outdir)
+                try:
+                    time.sleep(seconds)
+                finally:
+                    trace.set_active_profile(None)
+                    jax.profiler.stop_trace()
+                sink.emit("xprof_capture", source="serve", dir=outdir,
+                          seconds=seconds)
+                self._reply_json(200, {"dir": outdir,
+                                       "seconds": seconds})
+            except Exception as e:
+                self._reply_json(
+                    500, {"error": f"{type(e).__name__}: {e}"})
+            finally:
+                Handler._profile_lock.release()
 
     return Handler
 
@@ -292,6 +399,14 @@ def main(argv=None):
         from raft_tpu.obs import EventSink
 
         sink = EventSink(args.telemetry_dir)
+    trace_rate = (args.trace_sample_rate
+                  if args.trace_sample_rate is not None
+                  else float(os.environ.get("RAFT_TRACE_SAMPLE_RATE",
+                                            "0") or 0))
+    if trace_rate > 0:
+        from raft_tpu.obs import trace
+
+        trace.configure(sample_rate=trace_rate, sink=sink)
     if args.replicas > 1:
         from raft_tpu.serve import (FleetConfig, FlowRouter,
                                     ReplicaFleet, RouterConfig)

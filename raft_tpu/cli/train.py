@@ -206,6 +206,22 @@ def parse_args(argv=None):
                    help="hard-exit (code 42) when the watchdog fires, "
                         "so a hung multi-host job fails fast instead "
                         "of burning the pod")
+    p.add_argument("--trace_sample_rate", "--trace-sample-rate",
+                   type=float, default=None, metavar="RATE",
+                   help="distributed step tracing: fraction of steps "
+                        "that emit a `train_step` trace tree "
+                        "(queue_wait/prep/h2d/step_dispatch/ckpt_commit "
+                        "spans as trace_span events; errors, retries "
+                        "and non-finite steps always kept — "
+                        "docs/OBSERVABILITY.md).  Default "
+                        "$RAFT_TRACE_SAMPLE_RATE, unset = off; "
+                        "reconstruct with scripts/trace_report.py")
+    p.add_argument("--profile_steps", "--profile-steps", default=None,
+                   metavar="A:B",
+                   help="capture an XProf device profile for steps "
+                        "[A, B) into <telemetry_dir>/xprof/ and link "
+                        "the artifact dir from concurrently emitted "
+                        "trace spans (e.g. --profile-steps 100:105)")
     p.add_argument("--shard_spatial", type=int, default=1, metavar="N",
                    help="shard activations (image height) over N mesh "
                         "devices in addition to data parallelism — for "
@@ -353,6 +369,24 @@ def run(argv=None):
     if args.prefetch_batches < 0 or args.device_prefetch < 0:
         raise SystemExit("--prefetch_batches / --device_prefetch must "
                          "be >= 0")
+    trace_rate = (args.trace_sample_rate
+                  if args.trace_sample_rate is not None
+                  else float(os.environ.get("RAFT_TRACE_SAMPLE_RATE",
+                                            "0") or 0))
+    if not 0.0 <= trace_rate <= 1.0:
+        raise SystemExit(f"--trace_sample_rate must be in [0, 1], got "
+                         f"{trace_rate}")
+    profile_steps = None
+    if args.profile_steps:
+        try:
+            a, b = args.profile_steps.split(":")
+            profile_steps = (int(a), int(b))
+        except ValueError:
+            raise SystemExit(f"--profile_steps expects A:B (step "
+                             f"window), got {args.profile_steps!r}")
+        if profile_steps[1] <= profile_steps[0]:
+            raise SystemExit(f"--profile_steps window must be "
+                             f"non-empty, got {args.profile_steps!r}")
     per_host_batch = batch_size // num_hosts
     if per_host_batch % args.accum_steps:
         raise SystemExit(
@@ -377,7 +411,9 @@ def run(argv=None):
         watchdog_timeout=max(args.watchdog_timeout, 0.0),
         watchdog_exit=args.watchdog_exit,
         ckpt_dir=args.ckpt_dir,
-        ckpt_commit_window=max(args.ckpt_commit_window, 1))
+        ckpt_commit_window=max(args.ckpt_commit_window, 1),
+        trace_sample_rate=trace_rate,
+        profile_steps=profile_steps)
     dataset = fetch_dataset(args.stage, tuple(args.image_size),
                             root=args.data_root,
                             split_file=args.chairs_split)

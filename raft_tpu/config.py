@@ -399,6 +399,18 @@ class TrainConfig:
     # a hung multi-host job fails fast and gets rescheduled instead of
     # burning a pod.  Off: dump + event only.
     watchdog_exit: bool = False
+    # Distributed step tracing (raft_tpu/obs/trace.py): fraction of
+    # steps that open a `train_step` trace with queue_wait / prep /
+    # h2d / step_dispatch / ckpt_commit child spans, emitted as
+    # ``trace_span`` events into the telemetry sink.  Errors, retries
+    # and non-finite steps are always kept regardless of the sample
+    # coin (tail-based keep).  0 = tracing compiled out of the hot
+    # path (docs/OBSERVABILITY.md "Distributed tracing").
+    trace_sample_rate: float = 0.0
+    # On-demand XProf window: capture device profiles for steps
+    # [start, stop) into ``<telemetry_dir>/xprof/`` and link the
+    # directory from the step's trace spans.  None = off.
+    profile_steps: Optional[Tuple[int, int]] = None
     ckpt_dir: str = "checkpoints"
     # Bound on in-flight background checkpoint commits
     # (train/checkpoint.py save_async): the step loop never waits on

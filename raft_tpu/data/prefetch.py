@@ -127,6 +127,11 @@ class DevicePipeline:
         # Per-batch producer spans, valid right after next() returns.
         self.last_prep_s = 0.0
         self.last_h2d_s = 0.0
+        # (t_pull, t_prepped, t_put) perf_counter stamps for the batch
+        # just delivered — lets the train loop's step trace place the
+        # producer-side prep/h2d spans on the shared monotonic timeline
+        # (obs/trace.record_span) instead of only knowing durations.
+        self.last_stamps: Optional[tuple] = None
         self.last_host_batch = None
         # Cumulative, for the input microbench / pipeline stats.
         self.prep_total_s = 0.0
@@ -161,7 +166,7 @@ class DevicePipeline:
                 try:
                     batch = next(self._src)
                 except StopIteration:
-                    self._q.put((_END, None, None, 0.0, 0.0))
+                    self._q.put((_END, None, None, 0.0, 0.0, None))
                     return
                 t0 = time.perf_counter()
                 if self._prep is not None:
@@ -170,9 +175,10 @@ class DevicePipeline:
                 host = batch if self.keep_host else None
                 batch = self._put(batch)
                 t2 = time.perf_counter()
-                self._q.put((_ITEM, batch, host, t1 - t0, t2 - t1))
+                self._q.put((_ITEM, batch, host, t1 - t0, t2 - t1,
+                             (t0, t1, t2)))
         except BaseException as e:  # re-raised in the consumer
-            self._q.put((_ERROR, e, None, 0.0, 0.0))
+            self._q.put((_ERROR, e, None, 0.0, 0.0, None))
 
     # -- consumer --------------------------------------------------------
     def __iter__(self) -> "DevicePipeline":
@@ -200,10 +206,11 @@ class DevicePipeline:
             self.last_host_batch = batch if self.keep_host else None
             batch = self._put(batch)
             t2 = time.perf_counter()
+            self.last_stamps = (t0, t1, t2)
             self._account(t1 - t0, t2 - t1)
             return batch
         if self._interrupt is None:
-            kind, payload, host, prep_s, h2d_s = self._q.get()
+            kind, payload, host, prep_s, h2d_s, stamps = self._q.get()
         else:
             # Timed wait + flag re-check: a preemption request cannot
             # interrupt queue.get, so poll.  The poll costs nothing on
@@ -212,8 +219,8 @@ class DevicePipeline:
             # a SIGTERM during an input stall to interrupt_poll_s.
             while True:
                 try:
-                    kind, payload, host, prep_s, h2d_s = self._q.get(
-                        timeout=self._interrupt_poll_s)
+                    (kind, payload, host, prep_s, h2d_s,
+                     stamps) = self._q.get(timeout=self._interrupt_poll_s)
                     break
                 except queue.Empty:
                     if self._interrupt():
@@ -228,6 +235,7 @@ class DevicePipeline:
             raise payload
         self._slots.release()
         self.last_host_batch = host
+        self.last_stamps = stamps
         self._account(prep_s, h2d_s)
         return payload
 

@@ -11,6 +11,9 @@ Three composable pieces, shared by train/eval/serve:
   ``RAFT_TELEMETRY_DIR`` (or ``--telemetry-dir``); one record per
   event with wall+monotonic timestamps, step, and process index.
   ``scripts/telemetry_summary.py`` folds a log into bench.py JSON.
+- :class:`Tracer` / :func:`trace_span` — distributed request/step
+  trace trees emitted as ``trace_span`` events through the sink
+  (``obs.trace``; reconstructed by ``scripts/trace_report.py``).
 
 Hot-path contract: recording is lock-cheap, never forces a device
 sync, and the whole layer is a no-op when disabled.
@@ -37,6 +40,13 @@ from raft_tpu.obs.registry import (
     default_registry,
     span,
 )
+from raft_tpu.obs.trace import (
+    Tracer,
+    default_tracer,
+    record_span,
+    trace_span,
+    use_context,
+)
 
 __all__ = [
     "Counter",
@@ -45,8 +55,13 @@ __all__ = [
     "Histogram",
     "MetricRegistry",
     "PROMETHEUS_CONTENT_TYPE",
+    "Tracer",
     "default_registry",
     "default_sink",
+    "default_tracer",
+    "record_span",
     "reset_default_sink",
     "span",
+    "trace_span",
+    "use_context",
 ]

@@ -166,6 +166,17 @@ class HealthMonitor:
         flagged = [first_step + i for i, m in enumerate(per_step)
                    if float(np.asarray(m.get("nonfinite", 0.0))) > 0.5]
         self.nonfinite_total += len(flagged)
+        if flagged:
+            # Late tail-keep: the guard's verdict arrives a full log
+            # interval after the step ran, so the step's trace — if it
+            # lost the sampling coin — is sitting in the tracer's
+            # recently-dropped ring.  Recover it now.
+            try:
+                from raft_tpu.obs import trace
+
+                trace.default_tracer().emit_recent_dropped(steps=flagged)
+            except Exception:
+                pass  # telemetry must never fail the monitor
         last = per_step[-1]
         self.telemetry.record_health(
             first_step + len(per_step) - 1,

@@ -32,6 +32,24 @@ from typing import Callable, Dict, Optional, Tuple
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
+#: Cardinality guard: max distinct label-sets one metric may hold
+#: before new sets fold into the ``overflow="true"`` series
+#: (``RAFT_METRIC_MAX_LABELSETS`` overrides).  Unbounded label values
+#: (request ids, trace attrs) would otherwise grow ``/metrics`` — and
+#: registry memory — without bound on a long-running server.
+DEFAULT_MAX_LABELSETS = 256
+_OVERFLOW_KEY: Tuple[Tuple[str, str], ...] = (("overflow", "true"),)
+
+
+def _max_labelsets() -> int:
+    import os
+
+    raw = os.environ.get("RAFT_METRIC_MAX_LABELSETS", "")
+    try:
+        return max(int(raw), 1) if raw else DEFAULT_MAX_LABELSETS
+    except ValueError:
+        return DEFAULT_MAX_LABELSETS
+
 
 def _label_key(labels: Dict[str, object]) -> Tuple[Tuple[str, str], ...]:
     for k in labels:
@@ -53,9 +71,29 @@ class _Metric:
         self._registry = registry
         self._lock = threading.Lock()
         self._values: Dict[tuple, object] = {}
+        self._max_labelsets = _max_labelsets()
+        self._overflow_warned = False
 
     def _enabled(self) -> bool:
         return self._registry is None or self._registry.enabled
+
+    def _guard(self, key: tuple) -> tuple:
+        """Cardinality guard (caller holds ``self._lock``): an unseen
+        label set past the cap folds into ``overflow="true"`` — the
+        series count stays bounded, the recorded totals stay honest."""
+        if key in self._values or len(self._values) < self._max_labelsets:
+            return key
+        if not self._overflow_warned:
+            self._overflow_warned = True
+            import warnings
+
+            warnings.warn(
+                f"metric {self.name!r} hit the label-cardinality cap "
+                f"({self._max_labelsets} label sets; "
+                f"RAFT_METRIC_MAX_LABELSETS overrides) — folding new "
+                f'label sets into overflow="true"', RuntimeWarning,
+                stacklevel=4)
+        return _OVERFLOW_KEY
 
     def items(self):
         """``[(label_tuple, value), ...]`` snapshot (value semantics are
@@ -76,6 +114,7 @@ class Counter(_Metric):
             return
         key = _label_key(labels)
         with self._lock:
+            key = self._guard(key)
             self._values[key] = self._values.get(key, 0) + n
 
     def value(self, **labels) -> float:
@@ -91,6 +130,7 @@ class Gauge(_Metric):
             return
         key = _label_key(labels)
         with self._lock:
+            key = self._guard(key)
             self._values[key] = float(v)
 
     def value(self, **labels) -> Optional[float]:
@@ -122,6 +162,7 @@ class Histogram(_Metric):
             return
         key = _label_key(labels)
         with self._lock:
+            key = self._guard(key)
             st = self._values.get(key)
             if st is None:
                 st = self._values[key] = _HistState(self.reservoir)

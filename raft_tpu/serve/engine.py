@@ -63,6 +63,7 @@ from raft_tpu.chaos import (InjectedDeviceError, InjectedReplicaKill,
                             ReplicaWedgedInterrupt, is_transient_error)
 from raft_tpu.config import RAFTConfig
 from raft_tpu.obs import EventSink, MetricRegistry
+from raft_tpu.obs import trace
 from raft_tpu.ops.pad import InputPadder, bucket_hw
 from raft_tpu.serve.stats import Counters, LatencyRecorder
 from raft_tpu.utils.profiling import CompileCounter
@@ -184,7 +185,7 @@ class ServeConfig:
 
 class _Request:
     __slots__ = ("image1", "image2", "bucket", "padder", "future",
-                 "t_submit")
+                 "t_submit", "trace")
 
     def __init__(self, image1, image2, bucket, padder):
         self.image1 = image1
@@ -193,6 +194,13 @@ class _Request:
         self.padder = padder
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        # Trace context captured on the SUBMITTING thread (the router's
+        # attempt span, or whatever the caller had open) and carried
+        # across the dispatcher to the device worker, which records the
+        # per-request queue/pad/device child spans under it.  None when
+        # tracing is off or the request is untraced — the device worker
+        # then skips span recording entirely.
+        self.trace = trace.current()
 
 
 class InferenceEngine:
@@ -248,6 +256,10 @@ class InferenceEngine:
         # Seeded per-engine jitter source for the retry backoff ladder
         # (chaos drills must replay the recorded backoff_s values).
         self._retry_rng = np.random.default_rng(0)
+        # Retries the most recent _call_device performed (device-worker
+        # thread only) — stamped onto traced requests' device spans and
+        # the tail-keep trigger for retried batches.
+        self._last_retries = 0
         # One registry per engine: every stats/exposition figure below
         # reads these same metric objects (see serve/stats.py), and
         # cli/serve.py renders them at GET /metrics.
@@ -667,7 +679,9 @@ class InferenceEngine:
                 _, flow_up = exe(self._variables, a1, a2)
                 # np.asarray blocks on the transfer — async dispatch
                 # errors surface here, inside the retry scope.
-                return np.asarray(flow_up)
+                out = np.asarray(flow_up)
+                self._last_retries = attempt
+                return out
             except Exception as e:
                 if attempt >= self.cfg.device_retries \
                         or not is_transient_error(e):
@@ -740,16 +754,23 @@ class InferenceEngine:
         bs = next((s for s in self._batch_sizes if s >= n), n)
         t_start = time.perf_counter()
         self._batch_seq += 1
+        # Requests carrying a trace context get per-request queue/pad/
+        # device child spans; a batch with no traced request pays only
+        # this list comprehension.
+        traced = [r for r in reqs if r.trace is not None]
         try:
             self._chaos_replica_faults(self._batch_seq)
             exe = self._get_executable(bucket, bs)
+            t_pad0 = time.perf_counter()
             im1 = [r.padder.pad_np(r.image1) for r in reqs]
             im2 = [r.padder.pad_np(r.image2) for r in reqs]
             if bs > n:  # ballast lanes keep the compiled batch shape
                 im1 += [im1[-1]] * (bs - n)
                 im2 += [im2[-1]] * (bs - n)
-            flow_up = self._call_device(exe, np.stack(im1), np.stack(im2),
-                                        bucket, self._batch_seq)
+            a1, a2 = np.stack(im1), np.stack(im2)
+            t_pad1 = time.perf_counter()
+            flow_up = self._call_device(exe, a1, a2, bucket,
+                                        self._batch_seq)
             t_done = time.perf_counter()
             for j, r in enumerate(reqs):
                 r.future.set_result(
@@ -760,6 +781,19 @@ class InferenceEngine:
                             bucket=f"{bucket[0]}x{bucket[1]}", real=n,
                             ballast=bs - n,
                             seconds=round(t_done - t_start, 6))
+            if traced:
+                retries = self._last_retries
+                bk = f"{bucket[0]}x{bucket[1]}"
+                for r in traced:
+                    trace.record_span(r.trace, "queue", r.t_submit,
+                                      t_start, batch=self._batch_seq)
+                    trace.record_span(r.trace, "pad", t_pad0, t_pad1,
+                                      real=n, ballast=bs - n)
+                    trace.record_span(r.trace, "device", t_pad1, t_done,
+                                      bucket=bk, batch=self._batch_seq,
+                                      retries=retries)
+                    if retries:  # tail-keep: a retried batch is news
+                        r.trace.mark_keep()
         except Exception as e:
             for r in reqs:
                 if not r.future.done():
@@ -771,6 +805,15 @@ class InferenceEngine:
             self._sink.emit("serve_batch_error",
                             bucket=f"{bucket[0]}x{bucket[1]}", real=n,
                             error=f"{type(e).__name__}: {e}")
+            if traced:
+                t_err = time.perf_counter()
+                for r in traced:
+                    trace.record_span(r.trace, "queue", r.t_submit,
+                                      t_start, batch=self._batch_seq)
+                    trace.record_span(r.trace, "device", t_start, t_err,
+                                      status="error",
+                                      error=f"{type(e).__name__}",
+                                      batch=self._batch_seq)
         finally:
             with self._pending_lock:
                 self._pending -= len(reqs)

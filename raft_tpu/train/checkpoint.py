@@ -259,8 +259,13 @@ class CheckpointManager:
                 daemon=True)
             self._commit_thread.start()
         self._last_requested = int(step)
+        # Carry the caller's trace context across the thread hop so the
+        # commit shows up as a ``ckpt_commit`` span under the step trace
+        # that requested it (obs/trace; None when tracing is off).
+        from raft_tpu.obs import trace
+
         self._commit_q.put((int(step), snap, bool(force), mesh,
-                            time.perf_counter()))
+                            time.perf_counter(), trace.current()))
 
     def _commit_loop(self) -> None:
         while True:
@@ -268,12 +273,13 @@ class CheckpointManager:
             try:
                 if item is _SHUTDOWN:
                     return
-                step, snap, force, mesh, t_enq = item
-                self._commit_one(step, snap, force, mesh, t_enq)
+                step, snap, force, mesh, t_enq, ctx = item
+                self._commit_one(step, snap, force, mesh, t_enq, ctx)
             finally:
                 self._commit_q.task_done()
 
-    def _commit_one(self, step, snap, force, mesh, t_enq) -> None:
+    def _commit_one(self, step, snap, force, mesh, t_enq,
+                    ctx=None) -> None:
         t0 = time.perf_counter()
         try:
             self._mgr.save(step, args=ocp.args.StandardSave(snap),
@@ -292,9 +298,26 @@ class CheckpointManager:
             self._commit_err = e
             self._emit_commit(step, t0, t_enq, ok=False,
                               error=f"{type(e).__name__}: {str(e)[:200]}")
+            self._trace_commit(ctx, step, t0, ok=False)
             return
         ok, err = self._probe_commit(step)
         self._emit_commit(step, t0, t_enq, ok=ok, error=err)
+        self._trace_commit(ctx, step, t0, ok=ok)
+
+    def _trace_commit(self, ctx, step, t0, *, ok) -> None:
+        """Record the commit as a span under the requesting step's
+        trace (no-op when the caller wasn't traced)."""
+        if not ctx:
+            return
+        try:
+            from raft_tpu.obs import trace
+
+            trace.record_span(ctx, "ckpt_commit", t0,
+                              time.perf_counter(),
+                              status="ok" if ok else "error",
+                              step=int(step))
+        except Exception:
+            pass  # telemetry must never fail a commit
 
     def _emit_commit(self, step, t0, t_enq, *, ok, error=None) -> None:
         try:

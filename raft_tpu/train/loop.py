@@ -34,6 +34,7 @@ from raft_tpu import chaos
 from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.data.prefetch import DevicePipeline, PipelineInterrupted
 from raft_tpu.models.raft import RAFT
+from raft_tpu.obs import trace
 from raft_tpu.obs.health import HealthMonitor
 from raft_tpu.obs.train import TrainTelemetry
 from raft_tpu.obs.watchdog import StallWatchdog, stack_dump_path
@@ -215,7 +216,28 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
         noise_rng = np.random.default_rng(
             np.random.SeedSequence([cfg.seed + 1, step]))
         prep_fn = functools.partial(add_image_noise, noise_rng)
-    profiler = StepProfiler(profile_dir)
+    # Distributed step tracing (docs/OBSERVABILITY.md): each sampled
+    # step opens a `train_step` trace with queue_wait / prep / h2d /
+    # step_dispatch / ckpt_commit child spans.  rate 0 leaves ``tracer``
+    # None — the loop then does nothing per step but one identity check.
+    tracer = None
+    trace_rate = float(getattr(cfg, "trace_sample_rate", 0.0) or 0.0)
+    if trace_rate > 0:
+        tracer = trace.configure(
+            sample_rate=trace_rate, seed=cfg.seed,
+            sink=telem.sink if telem.enabled else None)
+    # On-demand XProf window (--profile-steps A:B): capture into
+    # <telemetry_dir>/xprof/ in ABSOLUTE step numbers and stamp the
+    # artifact dir onto concurrently recorded trace spans.
+    profile_steps = getattr(cfg, "profile_steps", None)
+    if profile_steps:
+        a, b = int(profile_steps[0]), int(profile_steps[1])
+        pdir = profile_dir or (os.path.join(telem.directory, "xprof")
+                               if telem.enabled else "xprof")
+        profiler = StepProfiler(pdir, start_step=a,
+                                num_steps=max(b - a, 1), absolute=True)
+    else:
+        profiler = StepProfiler(profile_dir)
     telem.start(start_step=step, num_steps=cfg.num_steps)
     # Training health (docs/OBSERVABILITY.md "Training health"): the
     # monitor is fed by the Logger's once-per-interval flush — the only
@@ -279,6 +301,10 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             if watchdog is not None:
                 watchdog.beat(step)
             t_iter = time.perf_counter()
+            # One trace root per sampled step; None when tracing is off
+            # (the rate=0 hot path costs only this identity check).
+            st = (tracer.start_trace("train_step", step=step)
+                  if tracer is not None else None)
             try:
                 sharded = next(pipeline)
             except StopIteration:
@@ -290,6 +316,15 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                 # consistent, same as the boundary exit below.
                 raise SystemExit(143)
             queue_wait_s = time.perf_counter() - t_iter
+            if st is not None:
+                trace.record_span(st, "queue_wait", t_iter,
+                                  t_iter + queue_wait_s)
+                if pipeline.last_stamps is not None:
+                    # Producer-side spans, stamped on the producer
+                    # thread and attached here (cross-thread handoff).
+                    p0, p1, p2 = pipeline.last_stamps
+                    trace.record_span(st, "prep", p0, p1)
+                    trace.record_span(st, "h2d", p1, p2)
             if step >= cfg.num_steps:
                 break
             if health is not None:
@@ -315,8 +350,21 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                 # stall (resumed below, after the hbm snapshot's own
                 # lower+compile).
                 watchdog.pause()
-            with annotate_step(step):
-                state, metrics = step_fn(state, sharded, key)
+            t_d0 = time.perf_counter()
+            try:
+                with annotate_step(step):
+                    state, metrics = step_fn(state, sharded, key)
+            except BaseException as e:
+                if st is not None:
+                    trace.record_span(st, "step_dispatch", t_d0,
+                                      time.perf_counter(),
+                                      status="error",
+                                      error=type(e).__name__)
+                    st.end(status="error", error=type(e).__name__)
+                raise
+            if st is not None:
+                trace.record_span(st, "step_dispatch", t_d0,
+                                  time.perf_counter())
             profiler.maybe_stop(step, sync_on=metrics.get("loss"))
             step += 1
             logger.push(step - 1, metrics)
@@ -344,6 +392,11 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
             telem.record_step(step - 1, step_time_s, queue_wait_s,
                               h2d_s=pipeline.last_h2d_s,
                               prep_s=pipeline.last_prep_s)
+            if st is not None:
+                # Flush point: sampled/kept traces emit now; the rest
+                # park in the dropped ring for a late verdict (the
+                # health monitor re-keeps non-finite steps at flush).
+                st.end(step_time_s=round(step_time_s, 6))
 
             # Second preemption check before the (potentially minutes-
             # long) validate block, so a SIGTERM during the step exits
@@ -365,8 +418,15 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                     watchdog.pause()  # save+validate is legitimately slow
                 # Non-blocking: the committer thread owns the I/O; this
                 # costs one on-device snapshot dispatch (bounded by the
-                # manager's commit window — docs/ROBUSTNESS.md).
-                mgr.save_async(step, state, mesh=mesh)
+                # manager's commit window — docs/ROBUSTNESS.md).  The
+                # step's trace context rides along so the committer's
+                # ckpt_commit span lands in the right tree (a late
+                # child: the root already flushed).
+                if st is not None:
+                    with trace.use_context(st):
+                        mgr.save_async(step, state, mesh=mesh)
+                else:
+                    mgr.save_async(step, state, mesh=mesh)
                 if validators:
                     variables = {"params": state.params}
                     if state.batch_stats:

@@ -73,26 +73,45 @@ class StepProfiler:
     Inactive (no overhead beyond two int compares) when ``trace_dir`` is
     None.  The first few steps are skipped by default so compilation does
     not pollute the trace.
+
+    ``absolute``: interpret ``start_step`` as an ABSOLUTE global step
+    number instead of an offset from the first observed step — the
+    ``--profile-steps A:B`` train flag targets a specific window of a
+    (possibly resumed) run.  While a capture is running the artifact
+    directory is stamped onto concurrently recorded trace spans
+    (``raft_tpu.obs.trace.set_active_profile``), linking the step
+    waterfall straight to its device profile.
     """
 
     trace_dir: Optional[str] = None
     start_step: int = 10          # relative to the first observed step
     num_steps: int = 5
+    absolute: bool = False
     _first_step: Optional[int] = None
     _running: bool = False
     _done: bool = False
+
+    def _link_trace(self, directory) -> None:
+        try:
+            from raft_tpu.obs import trace
+
+            trace.set_active_profile(directory)
+        except Exception:
+            pass  # profiling must not depend on the obs layer
 
     def maybe_start(self, step: int) -> None:
         if self.trace_dir is None or self._running or self._done:
             return
         # Anchor to the first step this run actually executes, so a
-        # checkpoint-resumed run still skips its compile steps.
+        # checkpoint-resumed run still skips its compile steps
+        # (absolute mode anchors at 0: start_step IS the global step).
         if self._first_step is None:
-            self._first_step = step
+            self._first_step = 0 if self.absolute else step
         if step - self._first_step < self.start_step:
             return
         jax.profiler.start_trace(self.trace_dir)
         self._running = True
+        self._link_trace(self.trace_dir)
 
     def maybe_stop(self, step: int, sync_on=None) -> None:
         """``sync_on``: a device array from the traced step (e.g. the loss).
@@ -110,6 +129,7 @@ class StepProfiler:
             jax.profiler.stop_trace()
             self._running = False
             self._done = True
+            self._link_trace(None)
             print(f"profiler trace written to {self.trace_dir}",
                   flush=True)
 
@@ -117,6 +137,7 @@ class StepProfiler:
         if self._running:
             jax.profiler.stop_trace()
             self._running = False
+            self._link_trace(None)
 
 
 def annotate_step(step: int):

@@ -77,6 +77,15 @@ def parse_args(argv=None):
                         "429 sheds excluded) exceeds this fraction — "
                         "a fleet drill that dropped requests must not "
                         "pass on throughput alone")
+    p.add_argument("--max-critical-path-ms", action="append",
+                   default=[], metavar="NAME:MS",
+                   help="fail when a newest record's "
+                        "config.critical_path_ms[NAME] (p95 self-time "
+                        "of span NAME on the trace critical path, from "
+                        "scripts/trace_report.py --json) exceeds MS; "
+                        "repeatable.  Also fails when NO record carries "
+                        "the figure — a latency gate must not pass "
+                        "because tracing silently turned off")
     p.add_argument("--require-tuned", action="store_true",
                    help="fail when a newest record's config lacks "
                         "`tuned: true` — i.e. its knobs did NOT come "
@@ -115,11 +124,34 @@ def build_series(paths):
     return series
 
 
+#: Span names every serve-rooted trace must contain (the engine's
+#: per-request instrumentation, raft_tpu/serve/engine.py) — a trace
+#: tree without them means the instrumentation silently broke.
+SERVE_REQUIRED_SPANS = ("queue", "pad", "device")
+
+
+def parse_cp_gates(items):
+    """``["device:50", ...] -> {"device": 50.0}``."""
+    gates = {}
+    for item in items or []:
+        name, sep, ms = str(item).rpartition(":")
+        try:
+            if not sep or not name:
+                raise ValueError
+            gates[name] = float(ms)
+        except ValueError:
+            raise SystemExit(f"--max-critical-path-ms expects NAME:MS "
+                             f"(e.g. device:50), got {item!r}")
+    return gates
+
+
 def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
           max_quarantined=0, max_ckpt_fallback=0, require_tuned=False,
-          max_serve_error_rate=0.0):
+          max_serve_error_rate=0.0, max_critical_path_ms=None):
     """``(failures, report)`` over the newest record of each metric."""
     failures, report = [], []
+    cp_gates = dict(max_critical_path_ms or {})
+    cp_seen = set()
     for metric, recs in sorted(series.items()):
         newest = recs[-1]
         value = newest.get("value")
@@ -162,6 +194,30 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
                 f"{metric}: error_rate={er:g} > {max_serve_error_rate:g}"
                 f" ({newest.get('errors', '?')} errors, "
                 f"{newest.get('timeouts', '?')} timeouts)")
+        # Trace-derived SLO gates (scripts/trace_report.py --json):
+        # per-span critical-path budgets, plus a coverage check — a
+        # serve trace tree missing the engine's queue/pad/device spans
+        # means the instrumentation regressed, and a latency gate over
+        # absent data would pass vacuously.
+        cp = cfg.get("critical_path_ms")
+        if isinstance(cp, dict):
+            for name, budget in cp_gates.items():
+                v = cp.get(name)
+                if isinstance(v, (int, float)):
+                    cp_seen.add(name)
+                    if v > budget:
+                        failures.append(
+                            f"{metric}: critical-path {name} p95 "
+                            f"{v:g}ms > budget {budget:g}ms")
+        sn = cfg.get("serve_span_names")
+        if isinstance(sn, list) and sn:
+            missing = sorted(set(SERVE_REQUIRED_SPANS) - set(sn))
+            if missing:
+                failures.append(
+                    f"{metric}: serve traces are missing the "
+                    f"{missing} span(s) — the request-path "
+                    "instrumentation is incomplete (engine spans "
+                    "lost?); refusing to gate on partial traces")
         if value is None:
             entry["skipped"] = "value null (backend unavailable)"
             report.append(entry)
@@ -185,6 +241,11 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
             failures.append(f"{metric}: vs_baseline {vs} < floor "
                             f"{min_vs_baseline}")
         report.append(entry)
+    for name in sorted(set(cp_gates) - cp_seen):
+        failures.append(
+            f"critical-path gate {name!r}: no record carries "
+            f"config.critical_path_ms[{name!r}] — tracing is off or "
+            "the span never appeared; the gate cannot pass vacuously")
     return failures, report
 
 
@@ -256,6 +317,27 @@ def _selftest() -> int:
         ("rejected-only record passes",
          run([30.0, 31.0, 30.5],
              last_top={"error_rate": 0.0, "rejected": 5}), False),
+        ("critical path within budget passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"critical_path_ms": {"device": 12.0}},
+             max_critical_path_ms={"device": 50.0}), False),
+        ("critical path over budget fails",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"critical_path_ms": {"device": 80.0}},
+             max_critical_path_ms={"device": 50.0}), True),
+        ("critical-path gate without data fails",
+         run([30.0, 31.0, 30.5],
+             max_critical_path_ms={"device": 50.0}), True),
+        ("serve span coverage complete passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"serve_span_names": ["attempt", "device", "pad",
+                                            "queue", "route"]}), False),
+        ("serve span coverage missing fails",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"serve_span_names": ["route", "attempt"]}), True),
+        ("no serve traces skips coverage",
+         run([30.0, 31.0, 30.5], last_cfg={"serve_span_names": []}),
+         False),
     ]
     bad = [name for name, (failures, _), want_fail in cases
            if bool(failures) != want_fail]
@@ -285,7 +367,9 @@ def main(argv=None):
                              max_quarantined=args.max_quarantined,
                              max_ckpt_fallback=args.max_ckpt_fallback,
                              require_tuned=args.require_tuned,
-                             max_serve_error_rate=args.max_serve_error_rate)
+                             max_serve_error_rate=args.max_serve_error_rate,
+                             max_critical_path_ms=parse_cp_gates(
+                                 args.max_critical_path_ms))
     print(json.dumps({"ok": not failures, "failures": failures,
                       "checked": report}))
     if failures:

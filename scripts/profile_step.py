@@ -10,7 +10,13 @@ Usage:
     python scripts/profile_step.py [outdir]
 Env: same knobs as bench.py (BENCH_BATCH, BENCH_IMAGE, BENCH_CORR_IMPL...).
 
-Outputs in <outdir> (default /tmp/raft_prof):
+Outputs in <outdir> (default ``$RAFT_TELEMETRY_DIR/xprof/bench-<ts>``
+when telemetry is configured, else ``/tmp/raft_prof``) — the same
+``xprof/`` layout the serve ``POST /debug/profile`` endpoint and the
+train ``--profile-steps`` flag write, so every capture lands where
+trace spans link to (``docs/OBSERVABILITY.md``).  An ``xprof_capture``
+event is emitted into the telemetry stream, and any trace span
+recorded during the capture carries ``xprof=<outdir>``:
     hlo_stats.json      per-op table (category, self time, FLOP rate)
     op_profile.json     xprof op_profile tree
     summary.txt         top self-time ops + per-category rollup
@@ -74,11 +80,19 @@ def capture(outdir: str) -> str:
         state, metrics = step_fn(state, batch, key)
     float(metrics["loss"])
 
+    # Link the capture into the tracing layer: spans recorded during
+    # the profiled window carry xprof=<outdir> (raft_tpu/obs/trace.py).
+    from raft_tpu.obs import trace
+
     jax.profiler.start_trace(outdir)
-    for _ in range(3):
-        state, metrics = step_fn(state, batch, key)
-    float(metrics["loss"])  # hard sync before stopping the trace
-    jax.profiler.stop_trace()
+    trace.set_active_profile(outdir)
+    try:
+        for _ in range(3):
+            state, metrics = step_fn(state, batch, key)
+        float(metrics["loss"])  # hard sync before stopping the trace
+    finally:
+        trace.set_active_profile(None)
+        jax.profiler.stop_trace()
 
     paths = glob.glob(os.path.join(outdir, "plugins/profile/*/*.xplane.pb"))
     if not paths:
@@ -157,11 +171,38 @@ def summarize(outdir: str) -> None:
     print(out)
 
 
+def default_outdir() -> str:
+    """Trace-linked layout when telemetry is configured, /tmp otherwise.
+
+    ``$RAFT_TELEMETRY_DIR/xprof/bench-<ts>`` is the same directory the
+    serve ``/debug/profile`` endpoint and the train ``--profile-steps``
+    flag use, so one telemetry dir holds traces AND their profiles."""
+    telem = os.environ.get("RAFT_TELEMETRY_DIR")
+    if telem:
+        return os.path.join(telem, "xprof",
+                            time.strftime("bench-%Y%m%d-%H%M%S"))
+    return "/tmp/raft_prof"
+
+
+def _emit_capture_event(outdir: str) -> None:
+    """Stamp the capture into the telemetry stream so trace_report /
+    telemetry_summary readers can find the artifacts later."""
+    try:
+        from raft_tpu.obs.events import default_sink
+
+        sink = default_sink()
+        if sink is not None:
+            sink.emit("xprof_capture", source="profile_step", dir=outdir)
+    except Exception:
+        pass  # telemetry must never fail a capture
+
+
 if __name__ == "__main__":
-    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/raft_prof"
+    outdir = sys.argv[1] if len(sys.argv) > 1 else default_outdir()
     os.makedirs(outdir, exist_ok=True)
     t0 = time.time()
     xplane = capture(outdir)
     print(f"captured {xplane} in {time.time()-t0:.0f}s")
+    _emit_capture_event(outdir)
     convert(xplane, outdir)
     summarize(outdir)

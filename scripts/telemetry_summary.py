@@ -66,30 +66,32 @@ def iter_records(path):
 
 
 def last_run(records):
-    """``(run_config, [train_step...], [train_health...], faults)`` of
-    the LAST run in the log (files append across runs; run_config marks
-    each start).  Logs from builds without training-health telemetry
-    simply yield an empty health list.
+    """``(run_config, [train_step...], [train_health...], faults,
+    [trace_span...])`` of the LAST run in the log (files append across
+    runs; run_config marks each start).  Logs from builds without
+    training-health or tracing telemetry simply yield empty lists.
 
     ``faults`` counts the fault-tolerance events (docs/ROBUSTNESS.md)
     over the WHOLE log, not just the last run: resume fallback fires
     BEFORE the resumed run's run_config is written, and a quarantined
     sample is data rot regardless of which restart hit it — the
     check_regression gate wants the conservative total."""
-    run_cfg, steps, health = None, [], []
+    run_cfg, steps, health, spans = None, [], [], []
     faults = {"sample_quarantine": 0, "ckpt_fallback": 0,
               "serve_retry": 0, "chaos_inject": 0}
     for rec in records:
         ev = rec.get("event")
         if ev == "run_config":
-            run_cfg, steps, health = rec, [], []
+            run_cfg, steps, health, spans = rec, [], [], []
         elif ev == "train_step":
             steps.append(rec)
         elif ev == "train_health":
             health.append(rec)
+        elif ev == "trace_span":
+            spans.append(rec)
         elif ev in faults:
             faults[ev] += 1
-    return run_cfg, steps, health, faults
+    return run_cfg, steps, health, faults, spans
 
 
 def _wait_s(rec):
@@ -101,7 +103,40 @@ def _wait_s(rec):
     return rec.get("queue_wait_s", rec.get("data_wait_s", 0.0))
 
 
-def summarize(run_cfg, steps, health=None, faults=None, skip=2):
+def trace_summary(spans):
+    """Fold ``trace_span`` records (raft_tpu/obs/trace.py) into
+    per-name duration percentiles plus trace-level counts.  Returns
+    ``{}`` for logs without tracing — old logs summarize unchanged."""
+    if not spans:
+        return {}
+    by_name = {}
+    roots = {}
+    for s in spans:
+        by_name.setdefault(s.get("name", "?"), []).append(
+            float(s.get("dur_s", 0.0)))
+        if s.get("parent_id") is None:
+            tid = s.get("trace_id")
+            roots[tid] = (roots.get(tid, False)
+                          or s.get("status") == "error")
+    span_ms = {}
+    for name, durs in sorted(by_name.items()):
+        durs.sort()
+        span_ms[name] = {
+            "p50_ms": round(durs[len(durs) // 2] * 1e3, 3),
+            "p95_ms": round(durs[min(int(len(durs) * 0.95),
+                                     len(durs) - 1)] * 1e3, 3),
+            "n": len(durs),
+        }
+    out = {"span_ms": span_ms}
+    if roots:
+        out["traces_total"] = len(roots)
+        out["traced_error_rate"] = round(
+            sum(1 for err in roots.values() if err) / len(roots), 4)
+    return out
+
+
+def summarize(run_cfg, steps, health=None, faults=None, spans=None,
+              skip=2):
     if run_cfg is None:
         raise SystemExit("no run_config event in log (telemetry written "
                          "by an older build?) — cannot recover batch "
@@ -147,6 +182,10 @@ def summarize(run_cfg, steps, health=None, faults=None, skip=2):
     for k in ("tuning_key", "tuning_registry_hash", "tuning_fallback"):
         if k in run_cfg:
             health_cfg[k] = run_cfg[k]
+    # Distributed-tracing fold (docs/OBSERVABILITY.md "Distributed
+    # tracing"): per-span-name duration percentiles + how many traces
+    # completed and what fraction erred.  Absent without trace events.
+    health_cfg.update(trace_summary(spans))
     last_health = (health or [None])[-1]
     if last_health is not None:
         health_cfg["nonfinite_steps_total"] = last_health.get(
@@ -181,8 +220,9 @@ def summarize(run_cfg, steps, health=None, faults=None, skip=2):
 
 def main(argv=None):
     args = parse_args(argv)
-    run_cfg, steps, health, faults = last_run(iter_records(args.path))
-    print(json.dumps(summarize(run_cfg, steps, health, faults,
+    run_cfg, steps, health, faults, spans = last_run(
+        iter_records(args.path))
+    print(json.dumps(summarize(run_cfg, steps, health, faults, spans,
                                skip=args.skip)))
 
 

@@ -64,6 +64,53 @@ def test_registry_disabled_is_noop():
     assert r.snapshot()["raft_g"]["values"] == {}
 
 
+def test_label_cardinality_guard(monkeypatch):
+    """Past the cap, UNSEEN label sets fold into ``overflow="true"``
+    (one RuntimeWarning, once): the series count stays bounded but the
+    totals stay honest, and already-seen sets keep updating in place."""
+    monkeypatch.setenv("RAFT_METRIC_MAX_LABELSETS", "4")
+    r = MetricRegistry()
+    c = r.counter("raft_capped_total")
+    for i in range(4):
+        c.inc(1, replica=f"r{i}")
+    with pytest.warns(RuntimeWarning, match="cardinality cap"):
+        c.inc(1, replica="r4")
+        c.inc(1, replica="r5")  # ... and only ONE warning for both
+    assert c.value(replica="r0") == 1      # existing series intact
+    assert c.value(replica="r4") == 0      # unseen set never created
+    assert c.value(overflow="true") == 2   # folded, not dropped
+    c.inc(1, replica="r0")                 # seen sets update past cap
+    assert c.value(replica="r0") == 2
+    assert len(c.items()) == 5             # 4 sets + overflow, bounded
+    assert 'overflow="true"' in r.render_prometheus()
+    # gauges and histograms run the same guard
+    g = r.gauge("raft_capped_g")
+    with pytest.warns(RuntimeWarning):
+        for i in range(6):
+            g.set(float(i), shard=f"s{i}")
+    assert g.value(overflow="true") == 5.0
+    h = r.histogram("raft_capped_seconds")
+    with pytest.warns(RuntimeWarning):
+        for i in range(6):
+            h.observe(1.0, bucket=f"b{i}")
+    count, total, _ = h.collect(overflow="true")
+    assert (count, total) == (2, 2.0)
+
+
+def test_cardinality_cap_env_default(monkeypatch):
+    """Unset / garbage env falls back to the shipped default; a
+    zero-or-negative override clamps to 1 (always at least one
+    real series)."""
+    from raft_tpu.obs import registry as regmod
+
+    monkeypatch.delenv("RAFT_METRIC_MAX_LABELSETS", raising=False)
+    assert regmod._max_labelsets() == regmod.DEFAULT_MAX_LABELSETS
+    monkeypatch.setenv("RAFT_METRIC_MAX_LABELSETS", "not-a-number")
+    assert regmod._max_labelsets() == regmod.DEFAULT_MAX_LABELSETS
+    monkeypatch.setenv("RAFT_METRIC_MAX_LABELSETS", "-3")
+    assert regmod._max_labelsets() == 1
+
+
 def test_registry_thread_safety():
     """Concurrent record + snapshot/render: no exceptions, no lost
     increments."""
