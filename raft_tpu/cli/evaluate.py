@@ -44,6 +44,24 @@ def _epe_delta_arg(value: str):
         raise argparse.ArgumentTypeError(str(e))
 
 
+def _early_exit_arg(value: str):
+    parts = [t.strip() for t in value.split(",") if t.strip()]
+    if not parts:
+        raise argparse.ArgumentTypeError(
+            f"--early_exit_threshold needs a comma list of >= 1 "
+            f"float, got {value!r}")
+    try:
+        thrs = [float(t) for t in parts]
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--early_exit_threshold values must be floats, "
+            f"got {value!r}")
+    if any(t < 0 for t in thrs):
+        raise argparse.ArgumentTypeError(
+            f"--early_exit_threshold values must be >= 0, got {value!r}")
+    return thrs
+
+
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="RAFT-TPU evaluation")
     p.add_argument("--model", required=True, help="checkpoint directory")
@@ -68,6 +86,15 @@ def parse_args(argv=None):
                         "per-metric deltas against the first (e.g. "
                         "'float32,int8' gates int8 against fp32 "
                         "storage); overrides --corr_dtype")
+    p.add_argument("--early_exit_threshold", default=None,
+                   type=_early_exit_arg, metavar="T[,T...]",
+                   help="accuracy-gate mode for adaptive early exit: "
+                        "sweep each convergence threshold against the "
+                        "full-iteration baseline (threshold 0) on the "
+                        "SAME checkpoint and report per-arm EPE deltas "
+                        "plus iters_used p50/p95 (the serve knob it "
+                        "gates is ServeConfig.early_exit_threshold; "
+                        "docs/SERVING.md)")
     p.add_argument("--alternate_corr", action="store_true",
                    help="memory-efficient on-demand correlation "
                         "(reference --alternate_corr)")
@@ -150,6 +177,18 @@ def main(argv=None):
         "sintel": dict(root=osp.join(args.data_root, "Sintel")),
         "kitti": dict(root=osp.join(args.data_root, "KITTI")),
     }
+    if args.early_exit_threshold:
+        # The adaptive-early-exit accuracy gate: same checkpoint, N
+        # convergence thresholds vs the full-iteration baseline.
+        kwargs = dict(roots[args.dataset])
+        if args.dataset == "kitti":
+            kwargs["bucket"] = not args.no_bucket
+        evaluate.evaluate_early_exit_delta(
+            variables, model_cfg, args.early_exit_threshold,
+            dataset=args.dataset, iters=iters,
+            batch_size=args.eval_batch, **kwargs)
+        return
+
     if args.epe_delta:
         # The quantization accuracy gate: same checkpoint, N corr
         # storage dtypes, per-metric deltas vs the first.

@@ -87,6 +87,20 @@ def parse_args(argv=None):
                    help="refinement iterations per request")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--batching", default="request",
+                   choices=["request", "slot"],
+                   help="request-level micro-batching, or continuous "
+                        "batching at GRU-iteration granularity over "
+                        "--slots persistent device lanes "
+                        "(docs/SERVING.md 'Continuous batching')")
+    p.add_argument("--slots", type=int, default=8,
+                   help="slot mode: persistent device lanes per bucket "
+                        "(tunable via scripts/autotune.py --kind serve)")
+    p.add_argument("--early-exit-threshold", type=float, default=0.0,
+                   help="slot mode: retire a request when its max flow "
+                        "update falls below this (0 = always run the "
+                        "full budget; pick a value the evaluate.py "
+                        "--early_exit_threshold sweep cleared)")
     p.add_argument("--max-batch", type=int, default=8,
                    help="micro-batch size cap")
     p.add_argument("--max-wait-ms", type=float, default=5.0,
@@ -381,7 +395,9 @@ def main(argv=None):
             {"params": rng, "dropout": rng}, img, img, iters=1)
 
     serve_cfg = ServeConfig(
-        iters=args.iters, max_batch=args.max_batch,
+        iters=args.iters, batching=args.batching, slots=args.slots,
+        early_exit_threshold=max(args.early_exit_threshold, 0.0),
+        max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
         buckets=_parse_hw_list(args.buckets) if args.buckets else None,
         batch_sizes=tuple(int(b) for b in args.batch_sizes.split(","))
@@ -443,6 +459,7 @@ def main(argv=None):
     host, port = server.server_address[:2]
     print(f"raft-tpu serve: listening on http://{host}:{port} "
           f"(backend={jax.default_backend()}, "
+          f"batching={args.batching}, "
           f"max_batch={args.max_batch}, max_wait_ms={args.max_wait_ms}, "
           f"max_queue={args.max_queue}{extra})", flush=True)
     try:

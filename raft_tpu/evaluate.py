@@ -439,3 +439,122 @@ def evaluate_epe_delta(variables, model_cfg: RAFTConfig, dtypes,
                         deltas={dt: d for dt, d in deltas.items()})
     return {"dataset": dataset, "dtypes": list(dtypes),
             "per_dtype": per_dtype, f"delta_vs_{base}": deltas}
+
+
+# Validation datasets the early-exit sweep can stream (monkeypatchable
+# seam, like VALIDATORS): name -> zero-config constructor.
+EARLY_EXIT_DATASETS = {
+    "chairs": lambda **kw: datasets.FlyingChairs(split="validation",
+                                                 **kw),
+    "sintel": lambda **kw: datasets.MpiSintel(split="training",
+                                              dstype="clean", **kw),
+    "kitti": lambda **kw: datasets.KITTI(split="training", **kw),
+}
+
+
+def _early_exit_flows(variables, runner, ds, mode: str, batch_size: int,
+                      iters: int, threshold: float, target=None):
+    """Stream ``ds`` through :class:`raft_tpu.serve.slots
+    .EarlyExitRunner` in fixed-shape batches; yields ``(sample,
+    flow (H, W, 2) np unpadded, iters_used)`` per image — the
+    early-exit mirror of :func:`_batched_flows`."""
+    n = len(ds)
+    for start in range(0, n, batch_size):
+        idxs = list(range(start, min(start + batch_size, n)))
+        samples = [ds.load(i) for i in idxs]
+        padders = [InputPadder(s["image1"].shape, mode=mode,
+                               target=target) for s in samples]
+        im1 = [p.pad_np(s["image1"]) for p, s in zip(padders, samples)]
+        im2 = [p.pad_np(s["image2"]) for p, s in zip(padders, samples)]
+        pad_n = batch_size - len(idxs)
+        if pad_n:  # keep the compiled batch shape on the final chunk
+            im1 += [im1[-1]] * pad_n
+            im2 += [im2[-1]] * pad_n
+        with span("raft_eval_forward", dataset=mode, emit=True):
+            flow_up, used = runner.run(variables, np.stack(im1),
+                                       np.stack(im2), iters, threshold)
+        for j, (s, p) in enumerate(zip(samples, padders)):
+            yield s, np.asarray(p.unpad(flow_up[j:j + 1])[0]), \
+                int(used[j])
+
+
+def evaluate_early_exit_delta(variables, model_cfg: RAFTConfig,
+                              thresholds, dataset: str = "chairs",
+                              iters: int = 24, batch_size: int = 4,
+                              bucket: bool = True,
+                              **dataset_kwargs) -> Dict:
+    """Same checkpoint, same data, N early-exit thresholds vs the
+    full-iteration baseline: the accuracy gate for adaptive early exit
+    (``--early_exit_threshold`` in the eval CLI; the serve knob it
+    clears is ``ServeConfig.early_exit_threshold``).
+
+    Arm 0 is ALWAYS the full-budget baseline (threshold 0 disables the
+    convergence cut, so every lane runs all ``iters`` refinements); each
+    requested threshold then re-streams the same samples through the
+    same :class:`~raft_tpu.serve.slots.EarlyExitRunner` — the identical
+    compiled ``encode``/``iter_step`` programs the serving engine runs,
+    so the measured EPE delta is exactly what slot-mode serving would
+    ship.  Per arm: ground-truth EPE, its delta vs baseline, and the
+    iters_used distribution (mean/p50/p95 — the throughput win).
+
+    Returns ``{"dataset", "iters", "thresholds", "per_threshold":
+    {thr: {"epe", "epe_delta", "iters_mean", "iters_p50", "iters_p95"}},
+    "delta_vs_full": {thr: epe_delta}}`` with threshold keys rendered
+    as strings (JSON-stable).
+
+    The regression gate (``scripts/check_regression.py
+    --max-early-exit-epe-delta``) reads the max ``delta_vs_full``
+    magnitude from the bench record this feeds."""
+    thrs = [float(t) for t in thresholds]
+    if not thrs:
+        raise ValueError("--early_exit_threshold needs >= 1 threshold")
+    if any(t < 0 for t in thrs):
+        raise ValueError(f"thresholds must be >= 0: {thrs}")
+    try:
+        make_ds = EARLY_EXIT_DATASETS[dataset]
+    except KeyError:
+        raise ValueError(f"unknown dataset {dataset!r}; choose from "
+                         f"{sorted(EARLY_EXIT_DATASETS)}")
+    from raft_tpu.serve.slots import EarlyExitRunner
+
+    arms, seen = [], set()
+    for t in [0.0] + thrs:          # baseline first, dedup after
+        if t not in seen:
+            seen.add(t)
+            arms.append(t)
+    runner = EarlyExitRunner(make_inference_model(model_cfg).config)
+    ds = make_ds(**dataset_kwargs)
+    target = _bucket_hw(ds) if bucket else None
+    per: Dict[str, Dict[str, float]] = {}
+    base_epe = None
+    for t in arms:
+        epes, used_all = [], []
+        print(f"--- early_exit_threshold={t:g} ---", flush=True)
+        for sample, flow, used in _early_exit_flows(
+                variables, runner, ds, dataset, batch_size, iters, t,
+                target=target):
+            epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
+            epes.append(epe.reshape(-1))
+            used_all.append(used)
+        epe = float(np.mean(np.concatenate(epes)))
+        used_np = np.asarray(used_all, np.float64)
+        if base_epe is None:
+            base_epe = epe
+        per[f"{t:g}"] = {
+            "epe": round(epe, 6),
+            "epe_delta": round(epe - base_epe, 6),
+            "iters_mean": round(float(used_np.mean()), 3),
+            "iters_p50": float(np.percentile(used_np, 50)),
+            "iters_p95": float(np.percentile(used_np, 95)),
+        }
+        print(f"early-exit thr={t:g} [{dataset}]: EPE {epe:.4f} "
+              f"(delta {epe - base_epe:+.4f}), iters p50 "
+              f"{per[f'{t:g}']['iters_p50']:g} p95 "
+              f"{per[f'{t:g}']['iters_p95']:g}", flush=True)
+    deltas = {k: v["epe_delta"] for k, v in per.items() if k != "0"}
+    default_sink().emit("eval_early_exit_delta", dataset=dataset,
+                        iters=iters, thresholds=[f"{t:g}" for t in arms],
+                        deltas=deltas)
+    return {"dataset": dataset, "iters": iters,
+            "thresholds": [f"{t:g}" for t in arms],
+            "per_threshold": per, "delta_vs_full": deltas}

@@ -267,6 +267,81 @@ class UpsampleLossStep(nn.Module):
         return carry, sums
 
 
+def _make_encoders(cfg: RAFTConfig):
+    """Construct the two shared-weight encoders with their canonical
+    scope names (``fnet``/``cnet``).  Called from inside a compact
+    method; used by both :class:`RAFT` and the slot-serving
+    :class:`RAFTEncode` so the param tree cannot drift between them."""
+    dt = cfg.dtype
+    hdim, cdim = cfg.hidden_dim, cfg.context_dim
+    if cfg.small:
+        fnet = SmallEncoder(128, "instance", cfg.dropout, dt, name="fnet")
+        cnet = SmallEncoder(hdim + cdim, "none", cfg.dropout, dt,
+                            name="cnet")
+    else:
+        fnet = BasicEncoder(256, "instance", cfg.dropout, dt, name="fnet")
+        cnet = BasicEncoder(hdim + cdim, "batch", cfg.dropout, dt,
+                            name="cnet")
+    return fnet, cnet
+
+
+def _encode_state(cfg: RAFTConfig, fnet, cnet, image1, image2, train,
+                  freeze_bn, flow_init=None):
+    """The pre-scan half of the forward pass: normalize → shared-weight
+    two-frame encode → correlation state → context split → initial
+    coordinate grids.  One body shared by :meth:`RAFT.__call__` and the
+    iteration-granular serving split (:class:`RAFTEncode`), so the
+    slot-mode parity pin (bit-identical to request mode) is structural
+    rather than a copy that has to be kept in sync."""
+    dt = cfg.dtype
+    hdim = cfg.hidden_dim
+
+    image1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
+    image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
+
+    # Shared-weight two-frame encode: stack on batch.
+    both = jnp.concatenate([image1, image2], axis=0)
+    fmaps = fnet(both.astype(dt), train, freeze_bn)
+    B = image1.shape[0]
+    fmap1 = fmaps[:B].astype(jnp.float32)
+    fmap2 = fmaps[B:].astype(jnp.float32)
+
+    corr_impl = cfg.resolved_corr_impl
+    if corr_impl == "allpairs":
+        # corr_dtype (storage) applies here too: the XLA lookup
+        # re-accumulates fp32 in _sample_windows regardless.
+        corr_state = build_corr_pyramid(
+            fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
+            out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
+    elif corr_impl == "allpairs_pallas":
+        corr_state = build_corr_pyramid_flat(
+            fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
+            pad_q=cfg.lookup_block_q,
+            out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
+    elif corr_impl in ("chunked", "pallas"):
+        if cfg.corr_dtype_is_quantized:
+            raise ValueError(
+                f"corr_dtype={cfg.resolved_corr_dtype!r} requires a "
+                "materialized pyramid (corr_impl 'allpairs' or "
+                "'allpairs_pallas'); the on-demand "
+                f"{corr_impl!r} path never stores the volume, so "
+                "there is nothing to quantize")
+        corr_state = (fmap1, pool_fmap_pyramid(fmap2, cfg.corr_levels))
+    else:
+        raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")
+
+    ctx = cnet(image1.astype(dt), train, freeze_bn)
+    net = jnp.tanh(ctx[..., :hdim])
+    inp = nn.relu(ctx[..., hdim:])
+
+    _, H8, W8, _ = fmap1.shape
+    coords0 = coords_grid(B, H8, W8)
+    coords1 = coords_grid(B, H8, W8)
+    if flow_init is not None:
+        coords1 = coords1 + flow_init
+    return net, inp, coords0, coords1, corr_state
+
+
 class RAFT(nn.Module):
     """Full / small RAFT (reference core/raft.py:24-144)."""
 
@@ -285,61 +360,11 @@ class RAFT(nn.Module):
         dict)`` instead of stacked flows (the γ-weighting is applied by
         the caller)."""
         cfg = self.config
-        dt = cfg.dtype
-        hdim, cdim = cfg.hidden_dim, cfg.context_dim
 
-        image1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
-        image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
-
-        # Shared-weight two-frame encode: stack on batch.
-        if cfg.small:
-            fnet = SmallEncoder(128, "instance", cfg.dropout, dt, name="fnet")
-            cnet = SmallEncoder(hdim + cdim, "none", cfg.dropout, dt,
-                                name="cnet")
-        else:
-            fnet = BasicEncoder(256, "instance", cfg.dropout, dt, name="fnet")
-            cnet = BasicEncoder(hdim + cdim, "batch", cfg.dropout, dt,
-                                name="cnet")
-
-        both = jnp.concatenate([image1, image2], axis=0)
-        fmaps = fnet(both.astype(dt), train, freeze_bn)
+        fnet, cnet = _make_encoders(cfg)
+        net, inp, coords0, coords1, corr_state = _encode_state(
+            cfg, fnet, cnet, image1, image2, train, freeze_bn, flow_init)
         B = image1.shape[0]
-        fmap1 = fmaps[:B].astype(jnp.float32)
-        fmap2 = fmaps[B:].astype(jnp.float32)
-
-        corr_impl = cfg.resolved_corr_impl
-        if corr_impl == "allpairs":
-            # corr_dtype (storage) applies here too: the XLA lookup
-            # re-accumulates fp32 in _sample_windows regardless.
-            corr_state = build_corr_pyramid(
-                fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
-                out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
-        elif corr_impl == "allpairs_pallas":
-            corr_state = build_corr_pyramid_flat(
-                fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
-                pad_q=cfg.lookup_block_q,
-                out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
-        elif corr_impl in ("chunked", "pallas"):
-            if cfg.corr_dtype_is_quantized:
-                raise ValueError(
-                    f"corr_dtype={cfg.resolved_corr_dtype!r} requires a "
-                    "materialized pyramid (corr_impl 'allpairs' or "
-                    "'allpairs_pallas'); the on-demand "
-                    f"{corr_impl!r} path never stores the volume, so "
-                    "there is nothing to quantize")
-            corr_state = (fmap1, pool_fmap_pyramid(fmap2, cfg.corr_levels))
-        else:
-            raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")
-
-        ctx = cnet(image1.astype(dt), train, freeze_bn)
-        net = jnp.tanh(ctx[..., :hdim])
-        inp = nn.relu(ctx[..., hdim:])
-
-        _, H8, W8, _ = fmap1.shape
-        coords0 = coords_grid(B, H8, W8)
-        coords1 = coords_grid(B, H8, W8)
-        if flow_init is not None:
-            coords1 = coords1 + flow_init
 
         if (loss_targets is not None and not cfg.small and not test_mode
                 and cfg.fuse_upsample_in_scan):
@@ -519,3 +544,84 @@ class RAFT(nn.Module):
         metrics = dict(flow_metrics(last_flow, flow_gt, vmask),
                        epe_iter=epe_iter)
         return per_iter, metrics
+
+
+# ---------------------------------------------------------------------------
+# Iteration-granular serving split (continuous batching)
+# ---------------------------------------------------------------------------
+#
+# The slot-based serve path (serve/slots.py) runs the forward pass as
+# two separately-jitted programs instead of one: ``encode`` (everything
+# before the refinement scan) and one refinement iteration at a time
+# (so requests can join/leave the device batch between iterations, and
+# converged samples can exit early).  The three modules below bind the
+# SAME parameter scopes as :class:`RAFT` — ``fnet``/``cnet``/``refine``/
+# ``upsampler`` — so a variables tree from ``RAFT.init`` (or any
+# checkpoint) applies unchanged; extra subtrees a given program does not
+# touch are simply never read.  The math is the scan body applied once.
+#
+# BOTH serve batching modes consume these same compiled programs —
+# ``batching=request`` drives them in whole-batch lockstep, ``slot``
+# continuously — which is what makes the slot-vs-request bitwise parity
+# pin (tests/test_serve_slots.py) structural: XLA:CPU specializes
+# reduction/fusion order to the surrounding program, so the same math
+# compiled into two DIFFERENT programs can differ in the last ulp (the
+# encoder's instance-norm and the corr einsum both do, measured ~1e-5
+# relative — ``optimization_barrier`` does not pin it).  Sharing one
+# executable chain sidesteps the whole class of drift.
+
+
+class RAFTEncode(nn.Module):
+    """Pre-scan half of the forward pass as a standalone program:
+    ``(image1, image2) -> (net, inp, coords0, coords1, corr_state)``.
+
+    Per-sample independent in inference mode (instance norm; batch norm
+    runs on stored statistics), so lanes of a slot batch can be encoded
+    together with ballast and scattered into slots without affecting
+    each other."""
+
+    config: RAFTConfig = RAFTConfig()
+
+    @nn.compact
+    def __call__(self, image1, image2,
+                 flow_init: Optional[jax.Array] = None):
+        fnet, cnet = _make_encoders(self.config)
+        return _encode_state(self.config, fnet, cnet, image1, image2,
+                             False, False, flow_init)
+
+
+class RAFTIterStep(nn.Module):
+    """One GRU refinement iteration as a standalone program — exactly
+    the scanned body (:class:`RefinementStep` under the ``refine``
+    scope, which ``variable_broadcast='params'`` leaves un-stacked, so
+    single application binds the identical tree).  The step is wrapped
+    with the same ``cfg.remat`` policy as the training/inference scan:
+    remat changes the compiled graph, and the slot-mode parity pin
+    requires the identical program body, not just identical weights."""
+
+    config: RAFTConfig = RAFTConfig()
+
+    @nn.compact
+    def __call__(self, net, coords1, inp, coords0, corr_state):
+        step = _remat_wrap(RefinementStep, self.config)
+        (net, coords1), _ = step(self.config, name="refine")(
+            (net, coords1), (inp, coords0, corr_state))
+        return net, coords1
+
+
+class RAFTUpsample(nn.Module):
+    """Final upsample as a standalone program: ``(net, flow_low) ->
+    flow_up`` — :class:`UpsampleStep` under the ``upsampler`` scope for
+    the full model, parameter-free ``upflow8`` for the small one (same
+    dispatch as the test-mode tail of :meth:`RAFT.__call__`)."""
+
+    config: RAFTConfig = RAFTConfig()
+
+    @nn.compact
+    def __call__(self, net, flow_low):
+        cfg = self.config
+        if cfg.small:
+            return upflow8(flow_low)
+        up = UpsampleStep(cfg, name="upsampler")
+        _, flow_up = up(None, net, flow_low)
+        return flow_up

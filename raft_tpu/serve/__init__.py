@@ -1,6 +1,8 @@
 """Online inference serving (see ``raft_tpu/serve/engine.py`` for the
 architecture: shape-bucketed AOT compile cache + dynamic micro-batching
-+ bounded-queue backpressure).
++ bounded-queue backpressure, and ``raft_tpu/serve/slots.py`` for
+continuous batching at GRU-iteration granularity with adaptive early
+exit — ``ServeConfig(batching="slot")``).
 
 Entry points::
 
@@ -30,10 +32,12 @@ from raft_tpu.serve.router import (
     RouterConfig,
     is_failover_error,
 )
+from raft_tpu.serve.slots import EarlyExitRunner
 from raft_tpu.serve.stats import LatencyRecorder
 
 __all__ = [
     "AOTImportError",
+    "EarlyExitRunner",
     "FleetConfig",
     "FlowRouter",
     "InferenceEngine",

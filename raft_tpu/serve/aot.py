@@ -1,27 +1,30 @@
 """AOT executable export/import: zero-compile replica warm-start.
 
-The engine's compile cache is keyed ``(bucket_hw, batch)`` and each
-entry is an explicit ``jit.lower(...).compile()`` product
-(``jax.stages.Compiled``).  XLA lets those be serialized
-(``jax.experimental.serialize_executable``), and — crucially — the
-executable takes the *variables pytree as a runtime argument*, so one
-exported artifact warm-starts a replica with ANY weights of the same
-tree structure: a supervised restart after a crash AND the warming
-engine of a rolling weight update both import the same blobs and serve
-their first request with **zero JIT compiles**
-(``CompileCounter``-asserted in ``tests/test_fleet.py``).
+The engine's compile cache is keyed ``(bucket_hw, lanes, program)``
+with ``program`` in ``{"enc", "iter"}`` (the iteration-granular
+serving split, ``serve/slots.py``), and each entry is an explicit
+``jit.lower(...).compile()`` product (``jax.stages.Compiled``).  XLA
+lets those be serialized (``jax.experimental.serialize_executable``),
+and — crucially — the executable takes the *variables pytree as a
+runtime argument*, so one exported artifact warm-starts a replica with
+ANY weights of the same tree structure: a supervised restart after a
+crash AND the warming engine of a rolling weight update both import
+the same blobs and serve their first request with **zero JIT
+compiles** (``CompileCounter``-asserted in ``tests/test_fleet.py``).
 
 Artifact layout (one directory)::
 
     manifest.json                  # fingerprint + key index (below)
     trees.pkl                      # pickled in/out pytree TEMPLATES
-    exe-<H>x<W>-b<B>.bin           # one serialized executable per key
+    exe-<H>x<W>-b<B>-<prog>.bin    # one serialized executable per key
 
-``trees.pkl`` holds the call's input/output tree *structures* rendered
-as plain int-leaf templates (``treedef.unflatten(range(n))``) — plain
-dicts/tuples, no jax objects — because ``serialize()`` returns treedefs
-that are not themselves portable.  All keys share one structure (the
-specs differ only in leaf shapes, which live inside the blobs).
+``trees.pkl`` holds each blob's input/output tree *structures*
+rendered as plain int-leaf templates (``treedef.unflatten(range(n))``)
+— plain dicts/tuples, no jax objects — because ``serialize()`` returns
+treedefs that are not themselves portable.  Trees are stored PER BLOB
+(format v2): the ``enc`` and ``iter`` programs take different pytrees,
+and the corr-state structure inside the slot state can vary with the
+corr impl/dtype.
 
 Compatibility gate: an artifact is refused (``AOTImportError``) unless
 its fingerprint — model config + variables tree structure/shapes/dtypes
@@ -46,7 +49,10 @@ from typing import Dict, Optional, Tuple
 
 MANIFEST = "manifest.json"
 TREES = "trees.pkl"
-FORMAT_VERSION = 1
+# v2: (bucket, lanes, program) keys + per-blob tree templates (the
+# iteration-granular serving split).  v1 artifacts (whole-forward
+# executables) are refused and the engine falls back to lazy compiles.
+FORMAT_VERSION = 2
 
 
 class AOTImportError(RuntimeError):
@@ -55,8 +61,8 @@ class AOTImportError(RuntimeError):
 
 
 def _blob_name(key: tuple) -> str:
-    (h, w), bs = key
-    return f"exe-{h}x{w}-b{bs}.bin"
+    (h, w), bs, prog = key
+    return f"exe-{h}x{w}-b{bs}-{prog}.bin"
 
 
 def model_fingerprint(model_cfg, variables, iters: int) -> str:
@@ -93,28 +99,28 @@ def _env_stamp() -> dict:
 
 def export_executables(executables: Dict[tuple, object], path: str, *,
                        fingerprint: str) -> dict:
-    """Serialize ``{(bucket, batch): Compiled}`` into directory
-    ``path`` (atomic per file: tmp + rename, so a concurrent importer
-    never sees a torn blob).  Returns the manifest written.  Keys
-    already exported with identical bytes are overwritten in place —
-    export is idempotent and may be re-run as the compile cache
-    grows."""
+    """Serialize ``{(bucket, lanes, program): Compiled}`` into
+    directory ``path`` (atomic per file: tmp + rename, so a concurrent
+    importer never sees a torn blob).  Returns the manifest written.
+    Keys already exported with identical bytes are overwritten in
+    place — export is idempotent and may be re-run as the compile
+    cache grows."""
     from jax.experimental import serialize_executable as se
 
     if not executables:
         raise ValueError("nothing to export: empty executable cache "
                          "(warm the engine first)")
     os.makedirs(path, exist_ok=True)
-    keys, trees = [], None
+    keys, trees = [], {}
     for key, exe in sorted(executables.items()):
         ser, in_tree, out_tree = se.serialize(exe)
-        if trees is None:
-            trees = (in_tree.unflatten(list(range(in_tree.num_leaves))),
-                     out_tree.unflatten(list(range(out_tree.num_leaves))))
         blob = _blob_name(key)
+        trees[blob] = (
+            in_tree.unflatten(list(range(in_tree.num_leaves))),
+            out_tree.unflatten(list(range(out_tree.num_leaves))))
         _atomic_write(os.path.join(path, blob), ser)
         keys.append({"bucket": list(key[0]), "batch": int(key[1]),
-                     "file": blob,
+                     "program": str(key[2]), "file": blob,
                      "sha256": hashlib.sha256(ser).hexdigest(),
                      "bytes": len(ser)})
     _atomic_write(os.path.join(path, TREES), pickle.dumps(trees))
@@ -157,12 +163,12 @@ def read_manifest(path: str) -> dict:
 def import_executables(path: str, *, fingerprint: str,
                        keys: Optional[Tuple[tuple, ...]] = None
                        ) -> Dict[tuple, object]:
-    """Load ``{(bucket, batch): Compiled}`` from an artifact directory,
-    gated on ``fingerprint`` + backend + jax version.  ``keys``
-    restricts the import (default: everything in the manifest).  Raises
-    :class:`AOTImportError` on any mismatch or corruption — partial
-    results are never returned (an artifact either warm-starts the
-    whole ladder or is refused)."""
+    """Load ``{(bucket, lanes, program): Compiled}`` from an artifact
+    directory, gated on ``fingerprint`` + backend + jax version.
+    ``keys`` restricts the import (default: everything in the
+    manifest).  Raises :class:`AOTImportError` on any mismatch or
+    corruption — partial results are never returned (an artifact
+    either warm-starts the whole ladder or is refused)."""
     import jax
     from jax.experimental import serialize_executable as se
 
@@ -179,17 +185,19 @@ def import_executables(path: str, *, fingerprint: str,
                 "export on this build)")
     try:
         with open(os.path.join(path, TREES), "rb") as f:
-            in_template, out_template = pickle.load(f)
+            trees = pickle.load(f)
     except (OSError, pickle.UnpicklingError, ValueError, EOFError) as e:
         raise AOTImportError(f"corrupt AOT tree templates: {e}")
-    in_tree = jax.tree_util.tree_structure(in_template)
-    out_tree = jax.tree_util.tree_structure(out_template)
+    if not isinstance(trees, dict):
+        raise AOTImportError("AOT tree templates are not the per-blob "
+                             "v2 layout (stale artifact?)")
 
     wanted = None if keys is None else {
-        (tuple(b), int(bs)) for (b, bs) in keys}
+        (tuple(b), int(bs), str(prog)) for (b, bs, prog) in keys}
     out: Dict[tuple, object] = {}
     for entry in manifest["keys"]:
-        key = (tuple(entry["bucket"]), int(entry["batch"]))
+        key = (tuple(entry["bucket"]), int(entry["batch"]),
+               str(entry["program"]))
         if wanted is not None and key not in wanted:
             continue
         blob_path = os.path.join(path, entry["file"])
@@ -202,6 +210,12 @@ def import_executables(path: str, *, fingerprint: str,
             raise AOTImportError(
                 f"AOT blob {entry['file']} checksum mismatch "
                 "(torn write?)")
+        templates = trees.get(entry["file"])
+        if templates is None:
+            raise AOTImportError(
+                f"AOT blob {entry['file']} has no tree template")
+        in_tree = jax.tree_util.tree_structure(templates[0])
+        out_tree = jax.tree_util.tree_structure(templates[1])
         try:
             out[key] = se.deserialize_and_load(ser, in_tree, out_tree)
         except Exception as e:

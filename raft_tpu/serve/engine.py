@@ -24,6 +24,23 @@ live here:
    item) up to the nearest compiled batch size, so batch shapes come
    from a small fixed set.
 
+3. **Iteration-granular continuous batching** (``batching="slot"``).
+   The unit of device work drops from one whole request to ONE GRU
+   iteration over a persistent slot batch (``serve/slots.py``): the
+   per-bucket dispatcher admits waiting requests into free slots
+   (running the ``encode`` program for the new lanes), runs one
+   ``iter_step`` over every active slot, and retires lanes whose
+   iteration budget is spent or whose convergence predicate fired
+   (max flow-update magnitude below ``early_exit_threshold``) — so a
+   24-iteration straggler no longer pins lanes that finished, and easy
+   inputs exit early (SEA-RAFT-style).  Whole-request mode stays the
+   default (``batching="request"``) and is the parity oracle: BOTH
+   modes drive the same two compiled ``encode``/``iter_step``
+   executables (request mode in whole-batch lockstep), so with early
+   exit disabled their outputs are bit-identical by construction —
+   XLA specializes fusion/reduction order per program, so this is the
+   only robust way to pin parity (see models/raft.py).
+
 Architecture (three kinds of thread, one device):
 
 - caller threads: ``submit()`` — bucket lookup, backpressure check,
@@ -124,7 +141,17 @@ class ServeConfig:
     (``aot_import_error`` event) and the engine compiles lazily.
     ``chaos_slow_s``/``chaos_hang_max_s`` size the injected
     ``replica_slow`` straggler sleep and the ``replica_hang`` wedge cap
-    (drills only; no effect without an installed fault plan)."""
+    (drills only; no effect without an installed fault plan).
+    ``batching``: ``"request"`` (whole-request micro-batches, the
+    default) or ``"slot"`` (iteration-granular continuous batching over
+    a persistent ``slots``-lane batch per bucket — docs/SERVING.md
+    "Continuous batching").  ``early_exit_threshold``: per-sample
+    convergence cut — a slot retires once its max flow-update magnitude
+    (flow units at 1/8 resolution) drops below this; ``0`` disables
+    (full budget always runs).  Slot mode honors a per-request
+    ``iters`` budget (capped at ``cfg.iters``); request mode runs every
+    lane to ``cfg.iters`` (lockstep).  All three are tuning-registry
+    knobs (``scripts/autotune.py --kind serve``)."""
 
     iters: int = 32
     max_batch: int = 8
@@ -145,10 +172,21 @@ class ServeConfig:
     aot_dir: Optional[str] = None
     chaos_slow_s: float = 0.5
     chaos_hang_max_s: float = 30.0
+    batching: str = "request"
+    slots: int = 8
+    early_exit_threshold: float = 0.0
 
     def __post_init__(self):
         if self.max_batch < 1 or self.max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
+        if self.batching not in ("request", "slot"):
+            raise ValueError(f"batching must be 'request' or 'slot', "
+                             f"got {self.batching!r}")
+        if self.slots < 1:
+            raise ValueError("slots must be >= 1")
+        if self.early_exit_threshold < 0:
+            raise ValueError("early_exit_threshold must be >= 0 "
+                             "(0 disables early exit)")
         if self.max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
         if self.stall_timeout_s < 0:
@@ -185,13 +223,16 @@ class ServeConfig:
 
 class _Request:
     __slots__ = ("image1", "image2", "bucket", "padder", "future",
-                 "t_submit", "trace")
+                 "t_submit", "trace", "iters")
 
-    def __init__(self, image1, image2, bucket, padder):
+    def __init__(self, image1, image2, bucket, padder, iters=None):
         self.image1 = image1
         self.image2 = image2
         self.bucket = bucket
         self.padder = padder
+        # Per-request iteration budget (slot mode honors it, capped at
+        # cfg.iters; request mode runs the full cfg.iters in lockstep).
+        self.iters = iters
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         # Trace context captured on the SUBMITTING thread (the router's
@@ -201,6 +242,58 @@ class _Request:
         # tracing is off or the request is untraced — the device worker
         # then skips span recording entirely.
         self.trace = trace.current()
+
+
+class _Programs:
+    """One ``(bucket, lanes)``'s compiled ``encode``/``iter_step`` pair
+    plus cached call constants: the all-zeros device-resident state the
+    lockstep (request-mode) pipeline restarts from, the all-lanes admit
+    mask, the full-budget vector, and the disabled threshold.  The
+    compiled programs are pure — ``state0`` is an input, never mutated —
+    so one ``_Programs`` serves every batch of its shape."""
+
+    __slots__ = ("enc", "it", "template", "state0", "mask_all",
+                 "budget_full", "thr_off", "bucket", "lanes")
+
+    def __init__(self, enc, it, template, bucket, lanes, full_iters):
+        self.enc = enc
+        self.it = it
+        self.template = template
+        self.state0 = jax.device_put(template)
+        self.mask_all = np.ones((lanes,), bool)
+        self.budget_full = np.full((lanes,), full_iters, np.int32)
+        self.thr_off = np.float32(0.0)
+        self.bucket = bucket
+        self.lanes = lanes
+
+
+class _SlotPool:
+    """Per-bucket slot bookkeeping for continuous batching: the
+    device-resident state pytree plus host mirrors of the lane
+    assignments.  Touched only by the bucket's dispatcher coroutine and
+    the device-worker call it awaits, so it needs no locking."""
+
+    __slots__ = ("progs", "state", "reqs", "budgets", "active_np",
+                 "t_admit")
+
+    def __init__(self, slots: int):
+        self.progs: Optional[_Programs] = None
+        self.state = None
+        self.reqs: List[Optional[_Request]] = [None] * slots
+        self.budgets = np.zeros((slots,), np.int32)
+        self.active_np = np.zeros((slots,), bool)
+        self.t_admit = [0.0] * slots
+
+    def live(self) -> List[_Request]:
+        return [r for r in self.reqs if r is not None]
+
+    def reset(self) -> None:
+        slots = len(self.reqs)
+        self.reqs = [None] * slots
+        self.budgets = np.zeros((slots,), np.int32)
+        self.active_np = np.zeros((slots,), bool)
+        if self.progs is not None:
+            self.state = self.progs.state0
 
 
 class InferenceEngine:
@@ -222,26 +315,43 @@ class InferenceEngine:
         # dependency is one function (the shared inference overrides).
         from raft_tpu import tuning
         from raft_tpu.evaluate import make_inference_model
+        from raft_tpu.serve import slots as slots_mod
 
-        self.cfg = cfg
         # Per-hardware tuning registry consult ('serve' entries first,
         # 'eval' as fallback): one model serves every bucket, so the
         # lookup is shape-agnostic (nearest/most-recent entry) — the
         # applied knobs and provenance surface in stats()["tuning"].
+        # A 'serve' entry may also carry ServeConfig knobs (batching /
+        # slots / early_exit_threshold / iters) — applied to whatever
+        # the caller left at its dataclass default, so explicit flags
+        # always win (raft_tpu/tuning.py precedence).
+        cfg, self.serve_tuning_info = tuning.resolve_serve_config(cfg)
+        self.cfg = cfg
         _, self.tuning_info = tuning.resolve_config(
             model_cfg, ("serve", "eval"))
         model = make_inference_model(model_cfg,
                                      tuning_kind=("serve", "eval"))
-        self._fwd = jax.jit(
-            lambda v, a, b: model.apply(v, a, b, iters=cfg.iters,
-                                        test_mode=True, train=False))
+        # The serve hot path is the encode/iter_step program pair
+        # (serve/slots.py) for BOTH batching modes — request mode drives
+        # them in lockstep so slot mode is bit-identical to it by
+        # construction (the parity pin, tests/test_serve_slots.py).
+        self._model_cfg = model.config
+        self._slots_mod = slots_mod
+        self._encode_jit = jax.jit(slots_mod.make_encode_fn(
+            self._model_cfg))
+        self._iter_jit = jax.jit(slots_mod.make_iter_fn(self._model_cfg))
         # Keep params resident on device: the executable is called with
         # this exact pytree every batch, so requests never re-upload it.
         self._variables = jax.device_put(variables)
         self._batch_sizes = cfg.resolved_batch_sizes()
         self._max_group = min(cfg.max_batch, self._batch_sizes[-1])
 
+        # Compiled-program cache: keys are (bucket_hw, lanes, program)
+        # with program in {"enc", "iter"}; _programs wraps each
+        # (bucket, lanes) pair with its cached zero state / lockstep
+        # constants.
         self._executables: Dict[tuple, object] = {}
+        self._programs: Dict[tuple, _Programs] = {}
         self._compile_lock = threading.Lock()
         # Crash/stop state: ``crashed`` holds the reason string once the
         # device worker hit a fatal (replica-killing) fault — the fleet
@@ -268,10 +378,20 @@ class InferenceEngine:
         self.compile_counter = CompileCounter(
             registry=self.registry, metric="raft_serve_compiles_total",
             labeler=lambda key: {"bucket": f"{key[0][0]}x{key[0][1]}",
-                                 "batch": str(key[1])})
+                                 "batch": str(key[1]),
+                                 "program": key[2]})
 
         self._latency = LatencyRecorder(cfg.latency_window,
                                         registry=self.registry)
+        # Iterations each request actually consumed before retiring —
+        # the early-exit win is this histogram's p50/p95 dropping below
+        # cfg.iters (docs/OBSERVABILITY.md).
+        self._iters_used = LatencyRecorder(
+            cfg.latency_window, registry=self.registry,
+            metric="raft_serve_iters_used",
+            help="refinement iterations a request consumed before "
+                 "retiring (early exit / per-request budget)",
+            scale=1.0, suffix="")
         self._counters = Counters(registry=self.registry)
         self._pending_gauge = self.registry.gauge(
             "raft_serve_pending_requests", "requests in flight")
@@ -345,11 +465,11 @@ class InferenceEngine:
         self._sink.emit("aot_import", dir=directory, keys=len(exes))
 
     def export_aot(self, directory: str) -> dict:
-        """Serialize every compiled ``(bucket, batch)`` executable into
-        ``directory`` (atomic per file) so a fresh engine built with
-        ``ServeConfig(aot_dir=directory)`` serves its first request
-        with zero compiles.  Returns the manifest.  Raises when the
-        cache is empty (warm up first)."""
+        """Serialize every compiled ``(bucket, lanes, program)``
+        executable into ``directory`` (atomic per file) so a fresh
+        engine built with ``ServeConfig(aot_dir=directory)`` serves its
+        first request with zero compiles.  Returns the manifest.
+        Raises when the cache is empty (warm up first)."""
         from raft_tpu.serve import aot as aot_mod
 
         with self._compile_lock:
@@ -437,12 +557,20 @@ class InferenceEngine:
     # client API (any thread)
     # ------------------------------------------------------------------
 
-    def submit(self, image1, image2) -> Future:
+    def submit(self, image1, image2,
+               iters: Optional[int] = None) -> Future:
         """Enqueue one frame pair; returns a Future resolving to the
         ``(H, W, 2)`` float32 flow at the ORIGINAL resolution.
 
+        ``iters`` is an optional per-request refinement budget, capped
+        at ``cfg.iters``; honored in slot mode (request mode runs every
+        lane to ``cfg.iters`` in lockstep — the parity oracle ignores
+        per-request budgets by design).
+
         Raises :class:`QueueFullError` immediately (never blocks) when
         ``max_queue`` requests are already in flight."""
+        if iters is not None and int(iters) < 1:
+            raise ValueError(f"iters must be >= 1, got {iters}")
         if not self._accepting:
             # Fail FAST with the precise lifecycle state — a client
             # racing stop() must get an immediate, classifiable error
@@ -476,7 +604,8 @@ class InferenceEngine:
             if self._pending == 0:
                 self._pending_since = time.perf_counter()
             self._pending += 1
-        req = _Request(im1, im2, bucket, padder)
+        req = _Request(im1, im2, bucket, padder,
+                       None if iters is None else int(iters))
         try:
             self._loop.call_soon_threadsafe(self._enqueue, req)
         except RuntimeError:  # loop closed under our feet (stop race)
@@ -485,30 +614,38 @@ class InferenceEngine:
             raise RuntimeError("engine stopped")
         return req.future
 
-    def infer(self, image1, image2,
-              timeout: Optional[float] = None) -> np.ndarray:
+    def infer(self, image1, image2, timeout: Optional[float] = None,
+              iters: Optional[int] = None) -> np.ndarray:
         """Blocking convenience wrapper around :meth:`submit`."""
-        return self.submit(image1, image2).result(timeout=timeout)
+        return self.submit(image1, image2,
+                           iters=iters).result(timeout=timeout)
 
     def warmup(self, image_shapes: Sequence[Tuple[int, int]],
                batch_sizes: Optional[Sequence[int]] = None) -> List[tuple]:
-        """Pre-compile the ``(bucket, batch)`` programs for the given raw
-        image ``(H, W)`` shapes (rounded through the same bucket policy
-        as live traffic), so first requests don't pay the compile.
-        Returns the list of keys compiled or already present."""
+        """Pre-compile the ``(bucket, lanes)`` program pairs for the
+        given raw image ``(H, W)`` shapes (rounded through the same
+        bucket policy as live traffic), so first requests don't pay the
+        compile.  Slot mode compiles one pair per bucket at
+        ``cfg.slots`` lanes (its only batch shape); request mode one
+        pair per ``(bucket, batch_size)``.  Returns the list of
+        ``(bucket, lanes)`` keys compiled or already present."""
         keys = []
         for (h, w) in image_shapes:
             bucket = bucket_hw(h, w, self.cfg.bucket_multiple,
                                self.cfg.buckets)
-            for bs in (batch_sizes or self._batch_sizes):
-                self._get_executable(bucket, int(bs))
-                keys.append((bucket, int(bs)))
+            if self.cfg.batching == "slot":
+                self._get_programs(bucket, self.cfg.slots)
+                keys.append((bucket, self.cfg.slots))
+            else:
+                for bs in (batch_sizes or self._batch_sizes):
+                    self._get_executable(bucket, int(bs))
+                    keys.append((bucket, int(bs)))
         return keys
 
     def compiled_keys(self) -> List[tuple]:
-        """``(bucket, batch)`` keys currently in the compile cache
-        (compiled here or AOT-imported) — what :meth:`export_aot` would
-        serialize."""
+        """``(bucket, lanes, program)`` keys currently in the compile
+        cache (compiled here or AOT-imported) — what :meth:`export_aot`
+        would serialize."""
         with self._compile_lock:
             return sorted(self._executables)
 
@@ -567,17 +704,22 @@ class InferenceEngine:
         with self._pending_lock:
             out["pending"] = self._pending
         out["latency_ms"] = self._latency.snapshot()
+        out["batching"] = self.cfg.batching
+        out["iters_used"] = self._iters_used.snapshot()
         out["compiles"] = {
-            f"{hw[0]}x{hw[1]}/b{bs}": n
-            for (hw, bs), n in sorted(self.compile_counter.counts().items())
+            f"{hw[0]}x{hw[1]}/b{bs}/{prog}": n
+            for (hw, bs, prog), n in sorted(
+                self.compile_counter.counts().items())
         }
         out["num_buckets"] = len(
-            {hw for (hw, _) in self.compile_counter.counts()})
+            {k[0] for k in self.compile_counter.counts()})
         # Tuning-registry provenance (raft_tpu/tuning.py): which knobs
         # this replica autotuned, so a fleet operator can tell a tuned
         # replica from one running hand-rolled defaults.
         out["tuning"] = dict(self.tuning_info.stamp(),
-                             applied=dict(self.tuning_info.applied))
+                             applied=dict(self.tuning_info.applied),
+                             serve_applied=dict(
+                                 self.serve_tuning_info.applied))
         # AOT warm-start provenance: how many executables this engine
         # imported instead of compiling (docs/SERVING.md fleet section).
         out["aot"] = dict(self.aot_info)
@@ -591,8 +733,11 @@ class InferenceEngine:
         q = self._queues.get(req.bucket)
         if q is None:
             q = self._queues[req.bucket] = asyncio.Queue()
+            runner = (self._slot_dispatcher
+                      if self.cfg.batching == "slot"
+                      else self._dispatcher)
             self._dispatchers[req.bucket] = self._loop.create_task(
-                self._dispatcher(req.bucket, q))
+                runner(req.bucket, q))
         q.put_nowait(req)
 
     async def _dispatcher(self, bucket: tuple, q: asyncio.Queue) -> None:
@@ -632,27 +777,131 @@ class InferenceEngine:
                     self._pending -= len(leftovers)
             raise
 
+    async def _slot_dispatcher(self, bucket: tuple,
+                               q: asyncio.Queue) -> None:
+        """Continuous-batching dispatcher (``batching="slot"``): one
+        persistent ``cfg.slots``-lane batch per bucket.  Each cycle
+        admits waiting requests into free slots and runs one
+        ``iter_step`` over the actives; the device work runs on the
+        single worker thread and IS awaited — unlike the request-mode
+        dispatcher there is nothing to pipeline (the next cycle's
+        admission depends on which lanes just retired), and awaiting
+        makes the pool/waiting-list single-owner (no locking).  The
+        loop blocks on the queue only when no lane is live and nothing
+        waits — otherwise it spins cycles, which is the point: device
+        work at iteration granularity."""
+        pool = _SlotPool(self.cfg.slots)
+        waiting: List[_Request] = []
+        try:
+            while True:
+                if not waiting and not pool.live():
+                    waiting.append(await q.get())
+                while True:
+                    try:
+                        waiting.append(q.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                await self._loop.run_in_executor(
+                    self._device_pool, self._slot_cycle, bucket, pool,
+                    waiting)
+        except asyncio.CancelledError:
+            leftovers = list(waiting) + pool.live()
+            waiting.clear()
+            pool.reset()
+            while not q.empty():
+                leftovers.append(q.get_nowait())
+            for r in leftovers:
+                if not r.future.done():
+                    r.future.set_exception(RuntimeError("engine stopped"))
+            if leftovers:
+                with self._pending_lock:
+                    self._pending -= len(leftovers)
+            raise
+
     # ------------------------------------------------------------------
     # internals — device-worker thread
     # ------------------------------------------------------------------
 
-    def _get_executable(self, bucket: tuple, batch_size: int):
-        key = (bucket, batch_size)
+    def _get_programs(self, bucket: tuple, lanes: int) -> _Programs:
+        """The compiled ``encode``/``iter_step`` pair for ``(bucket,
+        lanes)`` — compiled (or AOT-imported) exactly once per key, as
+        two explicit, counted events (``program`` label ``enc`` /
+        ``iter``).  Both batching modes call through here, so slot and
+        request mode can never run different device code."""
+        pkey = (bucket, lanes)
         with self._compile_lock:
-            exe = self._executables.get(key)
-            if exe is None:
-                H, W = bucket
-                spec = jax.ShapeDtypeStruct((batch_size, H, W, 3),
-                                            jnp.float32)
-                exe = self._fwd.lower(
-                    self._variables, spec, spec).compile()
-                self._executables[key] = exe
-                self.compile_counter.record(key)
-        return exe
+            progs = self._programs.get(pkey)
+            if progs is not None:
+                return progs
+            H, W = bucket
+            template = self._slots_mod.state_template(
+                self._model_cfg, self._variables, lanes, bucket)
+            state_spec = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                template)
+            im = jax.ShapeDtypeStruct((lanes, H, W, 3), jnp.float32)
+            mask = jax.ShapeDtypeStruct((lanes,), jnp.bool_)
+            budg = jax.ShapeDtypeStruct((lanes,), jnp.int32)
+            thr = jax.ShapeDtypeStruct((), jnp.float32)
+            enc = self._executables.get((bucket, lanes, "enc"))
+            if enc is None:
+                enc = self._encode_jit.lower(
+                    self._variables, im, im, state_spec, mask,
+                    budg).compile()
+                self._executables[(bucket, lanes, "enc")] = enc
+                self.compile_counter.record((bucket, lanes, "enc"))
+            it = self._executables.get((bucket, lanes, "iter"))
+            if it is None:
+                it = self._iter_jit.lower(
+                    self._variables, state_spec, thr).compile()
+                self._executables[(bucket, lanes, "iter")] = it
+                self.compile_counter.record((bucket, lanes, "iter"))
+            progs = _Programs(enc, it, template, bucket, lanes,
+                              self.cfg.iters)
+            self._programs[pkey] = progs
+            return progs
+
+    def _get_executable(self, bucket: tuple, batch_size: int):
+        """Request-mode device callable for one ``(bucket, batch)``:
+        ``(variables, a1, a2) -> (None, flow_up)``.
+
+        A thin lockstep pipeline over the SAME compiled program pair
+        slot mode runs — admit all lanes into a fresh zero state, run
+        exactly ``cfg.iters`` iter_steps with the threshold disabled;
+        every lane retires on the final step, which upsamples in-graph.
+        Pipelining through the pair (instead of one monolithic forward)
+        is what makes slot-vs-request parity bit-exact: XLA specializes
+        fusion/reduction order per program, so only sharing the
+        executables pins the bits (models/raft.py)."""
+        progs = self._get_programs(bucket, batch_size)
+        iters = self.cfg.iters
+
+        def pipeline(variables, a1, a2):
+            state = progs.enc(variables, a1, a2, progs.state0,
+                              progs.mask_all, progs.budget_full)
+            flow_up = None
+            for _ in range(iters):
+                state, flow_up = progs.it(variables, state,
+                                          progs.thr_off)
+            return None, flow_up
+
+        return pipeline
 
     def _call_device(self, exe, a1: np.ndarray, a2: np.ndarray,
                      bucket: tuple, seq: int) -> np.ndarray:
-        """Run one compiled batch with transient-error retry.
+        """Run one compiled batch with transient-error retry (the
+        request-mode thunk over :meth:`_retry_call`)."""
+
+        def thunk():
+            _, flow_up = exe(self._variables, a1, a2)
+            # np.asarray blocks on the transfer — async dispatch
+            # errors surface here, inside the retry scope.
+            return np.asarray(flow_up)
+
+        return self._retry_call(bucket, seq, thunk)
+
+    def _retry_call(self, bucket: tuple, seq: int, thunk):
+        """Run one device call (``thunk``) with transient-error retry.
 
         Errors classified transient (:func:`is_transient_error` — flaky
         dispatch/transport, or the injected ``device_err`` fault) are
@@ -664,9 +913,11 @@ class InferenceEngine:
         ``serve_retry`` event carrying the ACTUAL ``backoff_s`` slept —
         chaos drills assert the schedule from the event stream.
         Anything deterministic (shape/dtype/compile errors) raises on
-        the first attempt.  The host-side pad/stack is NOT inside the
+        the first attempt.  Host-side pad/stack work stays OUTSIDE the
         retry: it is deterministic, so re-running it could only repeat
-        its failure."""
+        its failure.  ``thunk`` must be safe to re-run — the slot
+        programs are pure (state in, state out), so a failed attempt
+        leaves the device state it read untouched."""
         attempt = 0
         t_first_try = time.perf_counter()
         while True:
@@ -676,10 +927,7 @@ class InferenceEngine:
                     raise InjectedDeviceError(
                         f"chaos-injected transient device error "
                         f"(batch {seq})")
-                _, flow_up = exe(self._variables, a1, a2)
-                # np.asarray blocks on the transfer — async dispatch
-                # errors surface here, inside the retry scope.
-                out = np.asarray(flow_up)
+                out = thunk()
                 self._last_retries = attempt
                 return out
             except Exception as e:
@@ -818,3 +1066,197 @@ class InferenceEngine:
             with self._pending_lock:
                 self._pending -= len(reqs)
                 self._last_batch_done = time.perf_counter()
+
+    # ------------------------------------------------------------------
+    # internals — device-worker thread, slot mode
+    # ------------------------------------------------------------------
+
+    def _slot_cycle(self, bucket: tuple, pool: _SlotPool,
+                    waiting: List[_Request]) -> None:
+        """One continuous-batching cycle: admit -> iterate.  Runs on
+        the device-worker thread while the bucket's dispatcher awaits;
+        ``waiting`` is the dispatcher's FIFO (drained here, oldest
+        first, into the lowest free slots)."""
+        self._batch_seq += 1
+        seq = self._batch_seq
+        try:
+            self._chaos_replica_faults(seq)
+            if pool.progs is None:
+                pool.progs = self._get_programs(bucket, self.cfg.slots)
+                pool.state = pool.progs.state0
+            if waiting:
+                free = [i for i in range(self.cfg.slots)
+                        if pool.reqs[i] is None]
+                if free:
+                    admits = [(i, waiting.pop(0))
+                              for i in free[:len(waiting)]]
+                    self._admit_slots(bucket, pool, admits, seq)
+            if pool.active_np.any():
+                self._iter_slots(bucket, pool, seq)
+        except Exception as e:
+            # Replica-fatal fault (kill/wedge) or an unexpected bug:
+            # every live lane's request dies with it; waiting requests
+            # stay queued (a crashed engine's stop() fails them, a
+            # surviving one serves them next cycle from a reset pool).
+            live = pool.live()
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            if live:
+                self._counters.add_failed_lanes(len(live))
+                with self._pending_lock:
+                    self._pending -= len(live)
+            pool.reset()
+            self._sink.emit("serve_slot_error",
+                            bucket=f"{bucket[0]}x{bucket[1]}",
+                            lanes=len(live),
+                            error=f"{type(e).__name__}: {e}")
+        finally:
+            with self._pending_lock:
+                self._last_batch_done = time.perf_counter()
+
+    def _admit_slots(self, bucket: tuple, pool: _SlotPool,
+                     admits: List[tuple], seq: int) -> None:
+        """Encode ``admits`` (``(slot_index, request)`` pairs) into
+        their lanes.  The encode program scatters fresh state into the
+        admitted lanes only — the other lanes' device state is carried
+        through bit-for-bit, so a failed admit (retries exhausted)
+        fails just the admitted requests and leaves every live lane
+        serving."""
+        S = self.cfg.slots
+        H, W = bucket
+        t0 = time.perf_counter()
+        a1 = np.zeros((S, H, W, 3), np.float32)
+        a2 = np.zeros((S, H, W, 3), np.float32)
+        admit = np.zeros((S,), bool)
+        budgets = pool.budgets.copy()
+        for i, r in admits:
+            a1[i] = r.padder.pad_np(r.image1)
+            a2[i] = r.padder.pad_np(r.image2)
+            admit[i] = True
+            budgets[i] = min(int(r.iters or self.cfg.iters),
+                             self.cfg.iters)
+        t_pad = time.perf_counter()
+
+        def thunk():
+            state = pool.progs.enc(self._variables, a1, a2, pool.state,
+                                   admit, budgets)
+            # Blocks on a small leaf — async dispatch errors surface
+            # here, inside the retry scope, before the pool commits.
+            active = np.asarray(state["active"])
+            return state, active
+
+        try:
+            state, active = self._retry_call(bucket, seq, thunk)
+        except Exception as e:
+            for _, r in admits:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            self._counters.add_failed_lanes(len(admits))
+            self._sink.emit("serve_admit_error",
+                            bucket=f"{bucket[0]}x{bucket[1]}",
+                            admits=len(admits),
+                            error=f"{type(e).__name__}: {e}")
+            with self._pending_lock:
+                self._pending -= len(admits)
+            return
+        pool.state = state
+        pool.active_np = active
+        pool.budgets = budgets
+        t_done = time.perf_counter()
+        for i, r in admits:
+            pool.reqs[i] = r
+            pool.t_admit[i] = t_done
+            if r.trace is not None:
+                trace.record_span(r.trace, "queue", r.t_submit, t0,
+                                  batch=seq, slot=i)
+                trace.record_span(r.trace, "pad", t0, t_pad, slot=i)
+        self._sink.emit("serve_admit",
+                        bucket=f"{bucket[0]}x{bucket[1]}",
+                        admits=len(admits), seq=seq,
+                        seconds=round(t_done - t0, 6))
+
+    def _iter_slots(self, bucket: tuple, pool: _SlotPool,
+                    seq: int) -> None:
+        """One ``iter_step`` over the active lanes; retire lanes whose
+        budget is spent or whose convergence predicate fired.  On a
+        non-transient failure every live request dies and the pool
+        resets to the zero state — the programs are pure, so a FAILED
+        attempt never corrupts state, and a retried one is
+        bit-identical to an uninterrupted run
+        (tests/test_serve_slots.py chaos case)."""
+        prev_active = pool.active_np.copy()
+        n_active = int(prev_active.sum())
+        thr = np.float32(self.cfg.early_exit_threshold)
+        t0 = time.perf_counter()
+
+        def thunk():
+            state, flow_up = pool.progs.it(self._variables, pool.state,
+                                           thr)
+            active = np.asarray(state["active"])
+            iters_done = np.asarray(state["iters_done"])
+            return state, flow_up, active, iters_done
+
+        try:
+            state, flow_up, active, iters_done = self._retry_call(
+                bucket, seq, thunk)
+        except Exception as e:
+            live = pool.live()
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            self._counters.add_failed_lanes(len(live))
+            self._sink.emit("serve_iter_error",
+                            bucket=f"{bucket[0]}x{bucket[1]}",
+                            lanes=len(live),
+                            error=f"{type(e).__name__}: {e}")
+            with self._pending_lock:
+                self._pending -= len(live)
+            pool.reset()
+            return
+        retries = self._last_retries
+        t_done = time.perf_counter()
+        pool.state = state
+        pool.active_np = active
+        self._counters.add_slot_step(n_active, self.cfg.slots)
+        bk = f"{bucket[0]}x{bucket[1]}"
+        # Iteration-level trace attribution: every traced request that
+        # was active this cycle gets an iter_step child span under its
+        # request root (trace_report.py critical paths then show which
+        # iterations a request actually waited on).
+        for i in np.nonzero(prev_active)[0]:
+            r = pool.reqs[int(i)]
+            if r is not None and r.trace is not None:
+                trace.record_span(r.trace, "iter_step", t0, t_done,
+                                  batch=seq, slot=int(i),
+                                  active=n_active)
+        newly = prev_active & ~active
+        if not newly.any():
+            return
+        flow_np = np.asarray(flow_up)
+        converged_np = np.asarray(state["converged"])
+        for i in np.nonzero(newly)[0]:
+            i = int(i)
+            r = pool.reqs[i]
+            pool.reqs[i] = None
+            if r is None:
+                continue
+            out = np.asarray(r.padder.unpad(flow_np[i:i + 1])[0])
+            if not r.future.done():
+                r.future.set_result(out)
+            used = int(iters_done[i])
+            self._latency.record(t_done - r.t_submit)
+            self._iters_used.record(used)
+            self._counters.add_completed()
+            self._sink.emit("serve_retire", bucket=bk, slot=i,
+                            iters=used,
+                            converged=bool(converged_np[i]),
+                            seconds=round(t_done - r.t_submit, 6))
+            if r.trace is not None:
+                trace.record_span(r.trace, "device", pool.t_admit[i],
+                                  t_done, bucket=bk, iters=used,
+                                  retries=retries)
+                if retries:  # tail-keep: a retried request is news
+                    r.trace.mark_keep()
+            with self._pending_lock:
+                self._pending -= 1

@@ -1,0 +1,240 @@
+"""Slot-state programs for iteration-granular continuous batching.
+
+The serve hot path (``serve/engine.py``) runs the forward pass as two
+compiled programs instead of one whole-request forward:
+
+- ``encode_admit(variables, image1, image2, state, admit, budgets)``:
+  run the pre-scan half (:class:`raft_tpu.models.raft.RAFTEncode`) over
+  the full slot batch and scatter the results into the lanes selected
+  by ``admit`` (a ``(S,)`` bool mask), leaving every other lane's
+  device-resident state untouched.  Inference encoders are per-lane
+  independent (instance norm / stored batch statistics), so encoding a
+  batch that carries ballast in the non-admitted lanes produces the
+  same bits for the admitted lanes as any other batch content would.
+- ``iter_step(variables, state, threshold)``: one GRU refinement
+  iteration (:class:`RAFTIterStep`) over every active lane, masked with
+  ``lax``-selects so retired/free lanes are no-ops.  The per-lane
+  convergence predicate (max flow-update magnitude below ``threshold``,
+  SEA-RAFT-style early exit) and the iteration-budget check both run
+  in-graph; lanes retiring THIS call get their flow upsampled
+  (:class:`RAFTUpsample`) inside the same program, guarded by a
+  ``lax.cond`` so iterations with no retiree skip the upsample.
+
+The slot state is a flat dict pytree (sorted keys, so treedefs are
+reproducible across processes for AOT export):
+
+======================  =========================  =======================
+key                     shape/dtype                meaning
+======================  =========================  =======================
+``active``              ``(S,) bool``              lane holds a live request
+``budget``              ``(S,) int32``             per-lane max iterations
+``converged``           ``(S,) bool``              early-exit predicate fired
+``coords0``             ``(S, H/8, W/8, 2) f32``   base coordinate grid
+``coords1``             ``(S, H/8, W/8, 2) f32``   current flow coordinates
+``corr``                corr-state pytree          per-lane corr pyramid
+``delta_max``           ``(S,) f32``               last step's max |Δflow|
+``inp``                 ``(S, H/8, W/8, C)``       context features
+``iters_done``          ``(S,) int32``             iterations consumed
+``net``                 ``(S, H/8, W/8, C)``       GRU hidden state
+======================  =========================  =======================
+
+Both serve batching modes drive these same two compiled programs
+(``batching=request`` in whole-batch lockstep — admit everyone, run
+exactly ``iters`` steps with the threshold disabled — ``slot``
+continuously), which is what makes slot-vs-request bitwise parity
+structural rather than numerical luck: XLA specializes reduction and
+fusion order per program, so the same math compiled into two different
+programs can differ in the last ulp (see the note in
+``models/raft.py``).
+
+``threshold`` is a runtime f32 scalar, not a compile-time constant, so
+sweeping it (``evaluate.py --early_exit_threshold``, autotune) never
+recompiles.  ``threshold <= 0`` disables early exit: ``delta_max`` is a
+max of norms, hence ``>= 0``, and the predicate is a strict ``<``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models.raft import RAFTEncode, RAFTIterStep, RAFTUpsample
+
+
+def _lane_select(mask, new, old):
+    """Per-leaf ``jnp.where`` with ``mask`` broadcast over a ``(S, ...)``
+    leaf's trailing dims (leaves of any rank, including zero-size
+    pyramid tails)."""
+    m = mask.reshape(mask.shape + (1,) * (new.ndim - 1))
+    return jnp.where(m, new, old.astype(new.dtype))
+
+
+def _pack_state(net, inp, coords0, coords1, corr_state, active, budget,
+                converged, delta_max, iters_done):
+    # Plain dict: insertion order is irrelevant to jax (dict pytrees
+    # flatten in sorted key order), matching the docstring table.
+    return {
+        "active": active,
+        "budget": budget,
+        "converged": converged,
+        "coords0": coords0,
+        "coords1": coords1,
+        "corr": corr_state,
+        "delta_max": delta_max,
+        "inp": inp,
+        "iters_done": iters_done,
+        "net": net,
+    }
+
+
+def state_template(model_cfg: RAFTConfig, variables, slots: int,
+                   bucket_hw: Tuple[int, int]) -> dict:
+    """Host-side all-zeros slot state for ``slots`` lanes at bucket
+    ``(H, W)`` — the engine's reset/initial state and the shape spec the
+    programs are lowered against.  Built from ``jax.eval_shape`` of the
+    encode program, so the corr-state leaf structure (including
+    quantized ``QuantizedLevel`` levels) can never drift from what
+    ``encode_admit`` actually produces."""
+    H, W = bucket_hw
+    spec = jax.ShapeDtypeStruct((slots, H, W, 3), jnp.float32)
+    net, inp, coords0, coords1, corr = jax.eval_shape(
+        RAFTEncode(model_cfg).apply, variables, spec, spec)
+    zeros = lambda s: np.zeros(s.shape, dtype=s.dtype)
+    lanes = lambda dt: np.zeros((slots,), dtype=dt)
+    return _pack_state(
+        zeros(net), zeros(inp), zeros(coords0), zeros(coords1),
+        jax.tree_util.tree_map(zeros, corr),
+        lanes(np.bool_), lanes(np.int32), lanes(np.bool_),
+        np.full((slots,), -1.0, np.float32), lanes(np.int32))
+
+
+def make_encode_fn(model_cfg: RAFTConfig):
+    """``encode_admit(variables, image1, image2, state, admit, budgets)
+    -> state'`` (pure; the engine jits/lowers it)."""
+    enc = RAFTEncode(model_cfg)
+
+    def encode_admit(variables, image1, image2, state, admit, budgets):
+        net, inp, coords0, coords1, corr = enc.apply(
+            variables, image1, image2)
+        sel = lambda new, old: _lane_select(admit, new, old)
+        return _pack_state(
+            sel(net, state["net"]),
+            sel(inp, state["inp"]),
+            sel(coords0, state["coords0"]),
+            sel(coords1, state["coords1"]),
+            jax.tree_util.tree_map(sel, corr, state["corr"]),
+            state["active"] | admit,
+            jnp.where(admit, budgets.astype(jnp.int32), state["budget"]),
+            state["converged"] & ~admit,
+            jnp.where(admit, jnp.float32(-1.0), state["delta_max"]),
+            jnp.where(admit, jnp.int32(0), state["iters_done"]),
+        )
+
+    return encode_admit
+
+
+def make_iter_fn(model_cfg: RAFTConfig):
+    """``iter_step(variables, state, threshold) -> (state', flow_up)``
+    (pure; the engine jits/lowers it).
+
+    ``flow_up`` is the full-resolution ``(S, H, W, 2)`` flow; only rows
+    whose lane retired THIS call (``active`` flipping true -> false)
+    are meaningful — the engine reads exactly those.  When no lane
+    retires, the upsample branch is skipped entirely (``lax.cond``) and
+    ``flow_up`` is zeros."""
+    step = RAFTIterStep(model_cfg)
+    upsample = RAFTUpsample(model_cfg)
+
+    def iter_step(variables, state, threshold):
+        active = state["active"]
+        net, coords1 = step.apply(
+            variables, state["net"], state["coords1"], state["inp"],
+            state["coords0"], state["corr"])
+        # Masked commit: inactive lanes keep their state bit-for-bit
+        # (free lanes carry zeros; a retired lane's state is dead until
+        # the next admit overwrites it, but must not drift meanwhile).
+        net = _lane_select(active, net, state["net"])
+        coords1 = _lane_select(active, coords1, state["coords1"])
+
+        delta = coords1 - state["coords1"]
+        dmax = jnp.max(jnp.sqrt(jnp.sum(delta * delta, axis=-1)),
+                       axis=(1, 2))
+        dmax = jnp.where(active, dmax, state["delta_max"])
+        iters_done = state["iters_done"] + active.astype(jnp.int32)
+        converged = active & (dmax < threshold)
+        done = active & (converged | (iters_done >= state["budget"]))
+
+        flow_low = coords1 - state["coords0"]
+        S, H8, W8 = flow_low.shape[0], flow_low.shape[1], flow_low.shape[2]
+
+        def _upsample(operands):
+            n, f = operands
+            return upsample.apply(variables, n, f)
+
+        def _skip(operands):
+            return jnp.zeros((S, H8 * 8, W8 * 8, 2), jnp.float32)
+
+        flow_up = jax.lax.cond(jnp.any(done), _upsample, _skip,
+                               (net, flow_low))
+        new_state = _pack_state(
+            net, state["inp"], state["coords0"], coords1,
+            state["corr"], active & ~done, state["budget"],
+            state["converged"] | converged, dmax, iters_done)
+        return new_state, flow_up
+
+    return iter_step
+
+
+class EarlyExitRunner:
+    """Offline (non-engine) driver of the slot programs over one fixed
+    batch: encode, then iterate until every lane retires, returning the
+    per-lane flow at ITS retirement iteration plus ``iters_used``.
+
+    This is the measurement arm for the ``evaluate.py
+    --early_exit_threshold`` quality gate and the early-exit tests:
+    same compiled programs the serve path runs, no dispatcher in the
+    way.  ``jax.jit`` call-site caching keys on shapes only, so
+    sweeping thresholds re-uses one compile per batch shape."""
+
+    def __init__(self, model_cfg: RAFTConfig):
+        self.model_cfg = model_cfg
+        self._encode = jax.jit(make_encode_fn(model_cfg))
+        self._iter = jax.jit(make_iter_fn(model_cfg))
+
+    def run(self, variables, image1, image2, iters: int,
+            threshold: float = 0.0):
+        """``(flow_up (B, H, W, 2) f32, iters_used (B,) i32)`` for a
+        ``/8``-aligned batch.  ``threshold <= 0`` reproduces the full
+        ``iters``-step baseline."""
+        B = int(np.asarray(image1).shape[0])
+        admit = jnp.ones((B,), jnp.bool_)
+        budgets = jnp.full((B,), int(iters), jnp.int32)
+        state = state_template(self.model_cfg, variables, B,
+                               tuple(np.asarray(image1).shape[1:3]))
+        state = self._encode(variables, image1, image2, state, admit,
+                             budgets)
+        thr = jnp.float32(threshold)
+        out = None
+        prev_active = np.ones((B,), bool)
+        iters_used = np.zeros((B,), np.int32)
+        for _ in range(int(iters)):
+            state, flow_up = self._iter(variables, state, thr)
+            active = np.asarray(state["active"])
+            newly = prev_active & ~active
+            if newly.any():
+                flow_np = np.asarray(flow_up)
+                if out is None:
+                    out = np.zeros(flow_np.shape, np.float32)
+                out[newly] = flow_np[newly]
+                iters_used[newly] = np.asarray(state["iters_done"])[newly]
+            prev_active = active
+            if not active.any():
+                break
+        assert out is not None and not prev_active.any(), \
+            "lanes left active after their budget — iter_step retire " \
+            "logic is broken"
+        return out, iters_used

@@ -28,47 +28,59 @@ from raft_tpu.obs import MetricRegistry
 
 
 class LatencyRecorder:
-    """Bounded reservoir of per-request latencies with percentile
+    """Bounded reservoir of per-request samples with percentile
     snapshots, backed by a registry histogram
-    (``raft_serve_request_latency_seconds``).
+    (``raft_serve_request_latency_seconds`` by default).
+
+    The default shape records seconds and snapshots milliseconds
+    (``p50_ms`` etc.); other per-request scalars reuse the same
+    reservoir with ``scale``/``suffix`` overridden — the engine's
+    ``raft_serve_iters_used`` histogram (iterations consumed before a
+    slot retired, continuous-batching mode) uses ``scale=1.0,
+    suffix=""`` and snapshots plain ``p50``/``p95``/``p99``/``mean``.
 
     Thread-safe: requests complete on the device-worker thread while
     ``snapshot`` is called from CLI/HTTP threads."""
 
     def __init__(self, window: int = 4096,
                  registry: Optional[MetricRegistry] = None,
-                 metric: str = "raft_serve_request_latency_seconds"):
+                 metric: str = "raft_serve_request_latency_seconds",
+                 help: str = "client-observed submit->result latency",
+                 scale: float = 1e3, suffix: str = "_ms"):
         self._hist = (registry or MetricRegistry()).histogram(
-            metric, "client-observed submit->result latency",
-            reservoir=window)
+            metric, help, reservoir=window)
+        self._scale = float(scale)
+        self._suffix = suffix
 
-    def record(self, seconds: float) -> None:
-        self._hist.observe(seconds)
+    def record(self, value: float) -> None:
+        self._hist.observe(value)
 
     def snapshot(self) -> Dict[str, float]:
-        """``{count, count_total, window_count, p50_ms, p95_ms, p99_ms,
-        mean_ms}``.
+        """``{count, count_total, window_count, p50<sfx>, p95<sfx>,
+        p99<sfx>, mean<sfx>}``.
 
-        ``count_total`` is the LIFETIME number of recorded requests;
-        the percentiles and ``mean_ms`` are computed over the recent
-        bounded window of ``window_count`` samples only (zeros when
-        nothing completed).  ``count`` is a backwards-compat alias for
+        ``count_total`` is the LIFETIME number of recorded samples;
+        the percentiles and mean are computed over the recent bounded
+        window of ``window_count`` samples only (zeros when nothing
+        completed).  ``count`` is a backwards-compat alias for
         ``count_total`` (older clients of the wire format read it);
         prefer the explicit names."""
+        sfx = self._suffix
         count, _total, window = self._hist.collect()
         if not window:
             return {"count": count, "count_total": count,
-                    "window_count": 0, "p50_ms": 0.0, "p95_ms": 0.0,
-                    "p99_ms": 0.0, "mean_ms": 0.0}
+                    "window_count": 0, f"p50{sfx}": 0.0,
+                    f"p95{sfx}": 0.0, f"p99{sfx}": 0.0,
+                    f"mean{sfx}": 0.0}
         vals = np.asarray(window, dtype=np.float64)
-        p50, p95, p99 = np.percentile(vals, [50, 95, 99]) * 1e3
+        p50, p95, p99 = np.percentile(vals, [50, 95, 99]) * self._scale
         return {"count": count,
                 "count_total": count,
                 "window_count": int(vals.size),
-                "p50_ms": round(float(p50), 3),
-                "p95_ms": round(float(p95), 3),
-                "p99_ms": round(float(p99), 3),
-                "mean_ms": round(float(vals.mean() * 1e3), 3)}
+                f"p50{sfx}": round(float(p50), 3),
+                f"p95{sfx}": round(float(p95), 3),
+                f"p99{sfx}": round(float(p99), 3),
+                f"mean{sfx}": round(float(vals.mean() * self._scale), 3)}
 
 
 class Counters:
@@ -104,6 +116,23 @@ class Counters:
                                   "ballast to reach a compiled size")
         self._failed = r.counter("raft_serve_lanes_failed_total",
                                  "real lanes lost to failed batches")
+        # Slot-mode (continuous batching) lane accounting: one
+        # iter_step over S slots with A active contributes A active
+        # and S total lanes; occupancy = active/total is the signal
+        # for sizing `slots` (docs/PERFORMANCE.md).
+        self._slot_steps = r.counter(
+            "raft_serve_slot_steps_total",
+            "iter_step device calls (continuous-batching mode)")
+        self._slot_active = r.counter(
+            "raft_serve_slot_lanes_active_total",
+            "slot lanes active across iter_step calls")
+        self._slot_lanes = r.counter(
+            "raft_serve_slot_lanes_total",
+            "slot lanes (active or idle) across iter_step calls")
+        self._slot_occ = r.gauge(
+            "raft_serve_slot_occupancy",
+            "active/total slot lanes over the engine lifetime "
+            "(continuous-batching mode)")
         self._uptime = r.gauge("raft_serve_uptime_seconds",
                                "seconds since the engine started")
         self._lock = threading.Lock()
@@ -133,6 +162,29 @@ class Counters:
         else:
             self._completed.inc(real)
 
+    def add_completed(self, n: int = 1) -> None:
+        """Slot-mode retirement: requests complete one at a time, not
+        per batch (batch accounting happens in :meth:`add_slot_step`)."""
+        self._completed.inc(n)
+
+    def add_failed_lanes(self, n: int) -> None:
+        """Slot-mode failure: ``n`` live lanes lost to a failed
+        encode/iter_step call (counts one batch error)."""
+        if n:
+            self._errors.inc()
+            self._failed.inc(n)
+
+    def add_slot_step(self, active: int, slots: int) -> None:
+        """One iter_step over ``slots`` lanes of which ``active`` held
+        live requests."""
+        self._slot_steps.inc()
+        self._slot_active.inc(active)
+        self._slot_lanes.inc(slots)
+        total = self._slot_lanes.value()
+        if total:
+            self._slot_occ.set(
+                round(self._slot_active.value() / total, 4))
+
     def snapshot(self, num_chips: int) -> Dict[str, float]:
         uptime = self._uptime_s()
         completed = self._completed.value()
@@ -141,6 +193,15 @@ class Counters:
         batches = self._batches.value()
         real_lanes = completed + failed_lanes
         total_lanes = real_lanes + ballast
+        # Continuous-batching mode: occupancy/fill describe the slot
+        # batch the dispatcher keeps resident, not padded micro-batches
+        # (which slot mode never builds).  Request-mode engines have
+        # zero slot lanes and keep the micro-batch math unchanged.
+        slot_lanes = self._slot_lanes.value()
+        if slot_lanes:
+            real_lanes = self._slot_active.value()
+            total_lanes = slot_lanes
+            batches = self._slot_steps.value()
         return {
             "uptime_s": round(uptime, 3),
             "completed": completed,
@@ -148,6 +209,7 @@ class Counters:
             "errors": self._errors.value(),
             "retries": self._retries.value(),
             "batches": batches,
+            "slot_steps": self._slot_steps.value(),
             "failed_lanes": failed_lanes,
             "mean_batch_fill": round(real_lanes / batches, 3)
             if batches else 0.0,

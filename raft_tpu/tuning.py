@@ -72,6 +72,14 @@ TUNABLE_KNOBS = (
     "upsample_unroll", "upsample_loss_kernel", "fuse_upsample_in_scan",
 )
 
+# ServeConfig-level knobs a kind='serve' entry may additionally carry
+# (the continuous-batching dispatcher surface — batching mode, slot
+# count, early-exit cut, iteration budget).  They resolve through
+# :func:`resolve_serve_config`, never through :func:`resolve_config`
+# (which only touches RAFTConfig).
+SERVE_TUNABLE_KNOBS = ("batching", "slots", "early_exit_threshold",
+                       "iters")
+
 _CONFIG_DEFAULTS = {f.name: f.default
                     for f in dataclasses.fields(RAFTConfig)}
 
@@ -174,11 +182,16 @@ def save_entry(kind: str, bucket_hw: Tuple[int, int], batch: int,
 
     Returns the entry key.  Unknown knob names are rejected here — the
     WRITE side is strict so the tolerant read side never has anything to
-    tolerate from our own tools."""
-    bad = sorted(set(knobs) - set(TUNABLE_KNOBS))
+    tolerate from our own tools.  ``kind='serve'`` entries may carry the
+    ServeConfig knob surface (:data:`SERVE_TUNABLE_KNOBS`) on top of the
+    model knobs."""
+    allowed = set(TUNABLE_KNOBS)
+    if kind == "serve":
+        allowed |= set(SERVE_TUNABLE_KNOBS)
+    bad = sorted(set(knobs) - allowed)
     if bad:
         raise ValueError(f"unknown tunable knob(s) {bad}; allowed: "
-                         f"{', '.join(TUNABLE_KNOBS)}")
+                         f"{', '.join(sorted(allowed))}")
     path = path or default_registry_path()
     device = device or device_kind()
     key = registry_key(kind, device, bucket_hw, batch)
@@ -310,3 +323,47 @@ def resolve_config(model_cfg: RAFTConfig,
     if applied:
         model_cfg = model_cfg.replace(**applied)
     return model_cfg, info
+
+
+def resolve_serve_config(serve_cfg,
+                         bucket_hw: Optional[Tuple[int, int]] = None,
+                         batch: Optional[int] = None,
+                         path: Optional[str] = None):
+    """Apply a ``kind='serve'`` registry entry's ServeConfig knobs
+    (:data:`SERVE_TUNABLE_KNOBS`) to every knob the user left at its
+    dataclass default — same precedence as :func:`resolve_config`
+    (explicit user knob > registry > default), same idempotence.
+
+    Returns ``(serve_cfg, TuningInfo)``.  Model knobs in the same entry
+    are ignored here (the engine resolves those onto RAFTConfig
+    separately); a registry value that fails ServeConfig validation is
+    dropped with a warning rather than crashing the engine."""
+    if not enabled():
+        return serve_cfg, TuningInfo(tuned=False)
+    hit = lookup("serve", bucket_hw, batch, path=path)
+    if hit is None:
+        return serve_cfg, TuningInfo(tuned=False)
+    key, entry, exact = hit
+    defaults = {f.name: f.default
+                for f in dataclasses.fields(type(serve_cfg))}
+    applied, pinned = {}, {}
+    for knob, value in entry.get("knobs", {}).items():
+        if knob not in SERVE_TUNABLE_KNOBS or knob not in defaults:
+            continue  # model knob or unknown: not ours
+        current = getattr(serve_cfg, knob)
+        if current != defaults[knob]:
+            pinned[knob] = current     # user (or caller) pinned it
+        elif current != value:
+            applied[knob] = value
+    reg_path = path or default_registry_path()
+    info = TuningInfo(tuned=True, key=key, exact=exact, applied=applied,
+                      pinned=pinned, registry_path=reg_path,
+                      registry_hash=registry_file_hash(reg_path))
+    if applied:
+        try:
+            serve_cfg = dataclasses.replace(serve_cfg, **applied)
+        except (ValueError, TypeError) as e:
+            warnings.warn(f"tuning entry {key!r} serve knobs {applied} "
+                          f"rejected by ServeConfig ({e}); ignored")
+            info = dataclasses.replace(info, applied={})
+    return serve_cfg, info

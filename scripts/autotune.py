@@ -16,7 +16,15 @@ this hardware with no human in the loop.
     python scripts/autotune.py                        # train, chairs crop
     python scripts/autotune.py --image 400x720 --batch-per-chip 8
     python scripts/autotune.py --kind eval            # test-mode forward
+    python scripts/autotune.py --kind serve           # engine dispatcher
     python scripts/autotune.py --tiny                 # CPU smoke (tier-1)
+
+``--kind serve`` sweeps the ServeConfig dispatcher surface (``batching``
+mode, ``slots``, ``early_exit_threshold`` — raft_tpu/serve/engine.py)
+through a real InferenceEngine on a closed-loop synthetic workload and
+persists the winner as a ``kind='serve'`` entry the engine consumes via
+``tuning.resolve_serve_config`` (request mode ignores the slot-mode
+knobs, so those points collapse to one measurement).
 
 A finished sweep records a ``sweep_id`` (hash of the grid + timing
 parameters + code version); re-running the same sweep against the same
@@ -53,9 +61,11 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(
         description="sweep the RAFTConfig knob surface, persist the "
                     "winner in the per-hardware tuning registry")
-    p.add_argument("--kind", default="train", choices=["train", "eval"],
-                   help="workload to tune: the jitted training step or "
-                        "the test-mode eval forward")
+    p.add_argument("--kind", default="train",
+                   choices=["train", "eval", "serve"],
+                   help="workload to tune: the jitted training step, "
+                        "the test-mode eval forward, or the serving "
+                        "engine's dispatcher knobs")
     p.add_argument("--image", default="368x496",
                    help="input HxW (the registry bucket key); default "
                         "is the chairs training crop")
@@ -148,6 +158,14 @@ def _grid(kind: str, tiny: bool, allow_quantized: bool):
     import jax
 
     on_tpu = jax.default_backend() == "tpu"
+    if kind == "serve":
+        if tiny:
+            return {"batching": ["request", "slot"], "slots": [2]}
+        return {
+            "batching": ["request", "slot"],
+            "slots": [4, 8, 16],
+            "early_exit_threshold": [0.0, 0.05, 0.2],
+        }
     if tiny:
         return {"scan_unroll": [1, 2]}
     if kind == "eval":
@@ -177,6 +195,19 @@ def _points(grid: dict, seed: int):
     keys = sorted(grid)
     pts = [dict(zip(keys, vals))
            for vals in itertools.product(*(grid[k] for k in keys))]
+    if "batching" in grid:
+        # Request mode ignores the slot-mode knobs: collapse every
+        # batching=request cross-product point to ONE canonical
+        # measurement instead of re-timing the identical config.
+        seen, uniq = set(), []
+        for p in pts:
+            if p.get("batching") == "request":
+                p = {"batching": "request"}
+            key = json.dumps(p, sort_keys=True)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(p)
+        pts = uniq
     random.Random(seed).shuffle(pts)
     return pts
 
@@ -275,6 +306,54 @@ def _time_eval_point(knobs, hw, batch, iters, steps, warmup, seed, tiny):
     return steps * batch / dt / max(jax.device_count(), 1)
 
 
+def _time_serve_point(knobs, hw, batch, iters, steps, warmup, seed,
+                      tiny):
+    """pairs/sec/chip of one ServeConfig knob point through a real
+    InferenceEngine on a closed-loop synthetic workload (``batch``
+    concurrent requests per wave, ``steps`` timed waves)."""
+    import jax
+    import numpy as np
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.serve.engine import InferenceEngine, ServeConfig
+
+    mk = RAFTConfig.small_model if tiny else RAFTConfig.full
+    model_cfg = mk()
+    H, W = hw
+    serve_kw = {k: knobs[k] for k in ("batching", "slots",
+                                      "early_exit_threshold")
+                if k in knobs}
+    cfg = ServeConfig(iters=int(knobs.get("iters", iters)),
+                      max_batch=batch, batch_sizes=(batch,),
+                      max_wait_ms=2.0, **serve_kw)
+    rng = np.random.default_rng(seed)
+    pairs = [(rng.uniform(0, 255, (H, W, 3)).astype(np.float32),
+              rng.uniform(0, 255, (H, W, 3)).astype(np.float32))
+             for _ in range(batch)]
+    model = RAFT(model_cfg)
+    small = np.zeros((1, 64, 96, 3), np.float32)
+    variables = jax.jit(
+        lambda k: model.init({"params": k, "dropout": k}, small, small,
+                             iters=2, train=False))(jax.random.PRNGKey(0))
+    eng = InferenceEngine(variables, model_cfg, cfg)
+    with eng:
+        eng.warmup([hw])
+
+        def wave():
+            futs = [eng.submit(a, b) for a, b in pairs]
+            for f in futs:
+                f.result(timeout=600)
+
+        for _ in range(max(warmup, 1)):
+            wave()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            wave()
+        dt = time.perf_counter() - t0
+    return steps * len(pairs) / dt / max(jax.device_count(), 1)
+
+
 def run_sweep(kind, hw, batch_per_chip, iters, steps, warmup, time_box,
               seed, out, force=False, tiny=False, allow_quantized=False):
     """Sweep -> persist winner.  Returns the result record (one JSON
@@ -303,9 +382,11 @@ def run_sweep(kind, hw, batch_per_chip, iters, steps, warmup, time_box,
 
     n_dev = max(jax.device_count(), 1)
     batch_global = batch_per_chip * n_dev
-    timer = _time_train_point if kind == "train" else _time_eval_point
-    unit = ("image-pairs/sec/chip" if kind == "train"
-            else "frames/sec/chip")
+    timer = {"train": _time_train_point, "eval": _time_eval_point,
+             "serve": _time_serve_point}[kind]
+    unit = {"train": "image-pairs/sec/chip",
+            "eval": "frames/sec/chip",
+            "serve": "pairs/sec/chip"}[kind]
     # The sweep must measure each point's RAW knobs — a registry consult
     # inside make_train_step would overwrite the very values under test
     # with the previous winner (a tuning feedback loop).

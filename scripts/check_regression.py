@@ -18,7 +18,16 @@ NEWEST record of each series is gated:
   clean number);
 - optional ``--min-vs-baseline``: newest ``vs_baseline`` below the
   floor -> exit 1 (BASELINE.json's 30 pairs/sec/chip north star is the
-  1.0 point of that field).
+  1.0 point of that field);
+- optional ``--max-early-exit-epe-delta``: adaptive early exit
+  (``ServeConfig.early_exit_threshold``) trades refinement iterations
+  for latency — this bounds what it may cost in accuracy.  The newest
+  records must carry ``config.early_exit_epe_delta`` (max |EPE delta|
+  vs the full-iteration baseline, from ``evaluate.py
+  --early_exit_threshold`` / ``bench_serve.py``) or the raw
+  ``config.early_exit_delta_vs_full`` arm dict; exceeding the budget
+  -> exit 1, and NO record carrying the figure also -> exit 1 (an
+  accuracy gate must not pass because the sweep silently didn't run).
 
 Records with ``value: null`` (backend unavailable — the CPU container
 writing TPU series) are reported but never gate, so the check is safe
@@ -77,6 +86,14 @@ def parse_args(argv=None):
                         "429 sheds excluded) exceeds this fraction — "
                         "a fleet drill that dropped requests must not "
                         "pass on throughput alone")
+    p.add_argument("--max-early-exit-epe-delta", type=float,
+                   default=None, metavar="EPE",
+                   help="fail when a newest record's early-exit EPE "
+                        "delta vs the full-iteration baseline "
+                        "(config.early_exit_epe_delta, or max |delta| "
+                        "over config.early_exit_delta_vs_full) exceeds "
+                        "this; also fails when NO record carries the "
+                        "figure (unset = no check)")
     p.add_argument("--max-critical-path-ms", action="append",
                    default=[], metavar="NAME:MS",
                    help="fail when a newest record's "
@@ -147,11 +164,13 @@ def parse_cp_gates(items):
 
 def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
           max_quarantined=0, max_ckpt_fallback=0, require_tuned=False,
-          max_serve_error_rate=0.0, max_critical_path_ms=None):
+          max_serve_error_rate=0.0, max_critical_path_ms=None,
+          max_early_exit_epe_delta=None):
     """``(failures, report)`` over the newest record of each metric."""
     failures, report = [], []
     cp_gates = dict(max_critical_path_ms or {})
     cp_seen = set()
+    ee_seen = False
     for metric, recs in sorted(series.items()):
         newest = recs[-1]
         value = newest.get("value")
@@ -209,6 +228,24 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
                         failures.append(
                             f"{metric}: critical-path {name} p95 "
                             f"{v:g}ms > budget {budget:g}ms")
+        # Early-exit accuracy gate: iterations saved by the convergence
+        # cut (docs/SERVING.md) must stay within the EPE budget the
+        # sweep measured (evaluate.py --early_exit_threshold).
+        if max_early_exit_epe_delta is not None:
+            ee = cfg.get("early_exit_epe_delta")
+            dv = cfg.get("early_exit_delta_vs_full")
+            if ee is None and isinstance(dv, dict):
+                arms = [abs(v) for v in dv.values()
+                        if isinstance(v, (int, float))]
+                ee = max(arms) if arms else None
+            if isinstance(ee, (int, float)):
+                ee_seen = True
+                if abs(ee) > max_early_exit_epe_delta:
+                    failures.append(
+                        f"{metric}: early-exit EPE delta {ee:g} exceeds "
+                        f"budget {max_early_exit_epe_delta:g} — the "
+                        "convergence threshold is trading too much "
+                        "accuracy for latency")
         sn = cfg.get("serve_span_names")
         if isinstance(sn, list) and sn:
             missing = sorted(set(SERVE_REQUIRED_SPANS) - set(sn))
@@ -246,6 +283,12 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
             f"critical-path gate {name!r}: no record carries "
             f"config.critical_path_ms[{name!r}] — tracing is off or "
             "the span never appeared; the gate cannot pass vacuously")
+    if max_early_exit_epe_delta is not None and not ee_seen:
+        failures.append(
+            "early-exit gate: no record carries "
+            "config.early_exit_epe_delta (or early_exit_delta_vs_full) "
+            "— the accuracy sweep did not run; the gate cannot pass "
+            "vacuously")
     return failures, report
 
 
@@ -338,6 +381,24 @@ def _selftest() -> int:
         ("no serve traces skips coverage",
          run([30.0, 31.0, 30.5], last_cfg={"serve_span_names": []}),
          False),
+        ("early-exit delta within budget passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"early_exit_epe_delta": 0.03},
+             max_early_exit_epe_delta=0.05), False),
+        ("early-exit delta over budget fails",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"early_exit_epe_delta": 0.09},
+             max_early_exit_epe_delta=0.05), True),
+        ("early-exit arm dict over budget fails",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"early_exit_delta_vs_full": {"0.05": 0.01,
+                                                    "0.2": -0.3}},
+             max_early_exit_epe_delta=0.05), True),
+        ("early-exit gate without data fails",
+         run([30.0, 31.0, 30.5], max_early_exit_epe_delta=0.05), True),
+        ("early-exit delta without the gate passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"early_exit_epe_delta": 9.0}), False),
     ]
     bad = [name for name, (failures, _), want_fail in cases
            if bool(failures) != want_fail]
@@ -369,7 +430,9 @@ def main(argv=None):
                              require_tuned=args.require_tuned,
                              max_serve_error_rate=args.max_serve_error_rate,
                              max_critical_path_ms=parse_cp_gates(
-                                 args.max_critical_path_ms))
+                                 args.max_critical_path_ms),
+                             max_early_exit_epe_delta=(
+                                 args.max_early_exit_epe_delta))
     print(json.dumps({"ok": not failures, "failures": failures,
                       "checked": report}))
     if failures:

@@ -41,3 +41,25 @@ done
 # 6. Spatial-shard artifact refresh (measured limit + spatial16 4K)
 python scripts/shard_beyond_hbm.py --out SHARD_BEYOND_HBM_r05.json \
     2>&1 | tee /tmp/shard_r05.log | tail -12
+
+# 7. Slot-mode serving re-baseline (PR 11 continuous batching): both
+#    batching arms over the same mixed-difficulty workload, one record
+#    with the slot/request p99 + throughput ratios -> BENCH_SERVE_SLOT_r05.json.
+#    Gate the early-exit threshold on accuracy first, then bench with it.
+python -m raft_tpu.cli.evaluate --model checkpoints/raft-things \
+    --dataset sintel --early_exit_threshold 0.05,0.2 \
+    2>&1 | tee /tmp/ee_sweep_r05.log | tail -8
+#    (stamp the sweep's measured delta so check_regression's
+#    --max-early-exit-epe-delta gate has the figure)
+python scripts/bench_serve.py --batching both --shapes 440x1024 \
+    --requests 128 --concurrency 16 --early-exit-threshold 0.05 \
+    --early-exit-epe-delta "$(grep -o 'thr=0.05 .*delta [+-][0-9.]*' \
+        /tmp/ee_sweep_r05.log | grep -o '[+-][0-9.]*$' | tr -d + \
+        || echo 0)" \
+    2>&1 | tee /tmp/bench_serve_slot_r05.log | tail -1 \
+    > BENCH_SERVE_SLOT_r05.json
+
+# 8. Serve-knob autotune at the serving shape (persists batching/slots/
+#    early_exit_threshold winners under kind="serve" for this chip)
+python scripts/autotune.py --kind serve --image 440x1024 \
+    --batch-per-chip 8 2>&1 | tee /tmp/autotune_serve_r05.log | tail -3

@@ -130,7 +130,7 @@ def test_aot_import_gates_and_corruption(variables, aot_dir, tmp_path):
 
     fp = aot_mod.model_fingerprint(CFG, variables, ITERS)
     exes = aot_mod.import_executables(aot_dir, fingerprint=fp)
-    assert set(exes) == {((40, 56), 2)}
+    assert set(exes) == {((40, 56), 2, "enc"), ((40, 56), 2, "iter")}
 
     with pytest.raises(aot_mod.AOTImportError, match="fingerprint"):
         aot_mod.import_executables(aot_dir, fingerprint="deadbeef")
@@ -152,7 +152,7 @@ def test_engine_aot_preload_zero_compiles(variables, aot_dir):
     CompileCounter == 0 — the fleet's warm-start contract."""
     eng = InferenceEngine(variables, CFG,
                           _serve_cfg(aot_dir=aot_dir))
-    assert eng.aot_info["ok"] is True and eng.aot_info["imported"] == 1
+    assert eng.aot_info["ok"] is True and eng.aot_info["imported"] == 2
     eng.start()
     try:
         im1, im2 = _images(np.random.default_rng(1))
@@ -160,7 +160,7 @@ def test_engine_aot_preload_zero_compiles(variables, aot_dir):
         assert flow.shape == SHAPE + (2,)
         assert np.isfinite(flow).all()
         assert eng.compile_counter.counts() == {}
-        assert eng.stats()["aot"]["imported"] == 1
+        assert eng.stats()["aot"]["imported"] == 2
     finally:
         eng.stop()
 
@@ -175,7 +175,8 @@ def test_engine_aot_miss_falls_back_to_lazy_jit(variables, tmp_path):
     try:
         im1, im2 = _images(np.random.default_rng(1))
         assert eng.infer(im1, im2, timeout=120).shape == SHAPE + (2,)
-        assert eng.compile_counter.counts() == {((40, 56), 2): 1}
+        assert eng.compile_counter.counts() == {
+            ((40, 56), 2, "enc"): 1, ((40, 56), 2, "iter"): 1}
     finally:
         eng.stop()
 

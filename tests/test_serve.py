@@ -59,8 +59,8 @@ def test_mixed_load_matches_eval_and_compiles_once(engine, variables):
     """Two waves of concurrent mixed-resolution requests: every flow
     comes back unpadded at its own resolution and matches the offline
     ``evaluate.make_eval_fn`` batch-1 forward; the compile ledger shows
-    EXACTLY one compile per (bucket, batch) — wave 2 reuses wave 1's
-    programs."""
+    EXACTLY one encode + one iter_step compile per (bucket, batch) —
+    wave 2 reuses wave 1's programs."""
     from raft_tpu import evaluate
 
     rng = np.random.default_rng(1)
@@ -74,7 +74,9 @@ def test_mixed_load_matches_eval_and_compiles_once(engine, variables):
             assert f.result(timeout=120).shape == (h, w, 2)
 
     counts = engine.compile_counter.counts()
-    assert counts == {((40, 56), 4): 1, ((64, 96), 4): 1}, counts
+    assert counts == {((40, 56), 4, "enc"): 1, ((40, 56), 4, "iter"): 1,
+                      ((64, 96), 4, "enc"): 1,
+                      ((64, 96), 4, "iter"): 1}, counts
     stats = engine.stats()
     assert stats["num_buckets"] == len(SHAPES)
     assert stats["completed"] == 2 * len(reqs)

@@ -244,3 +244,70 @@ def test_fallback_distance_cutoff(reg):
     # things crop stays within reach
     hit = tuning.lookup("train", (400, 720), 8, path=reg)
     assert hit is not None and not hit[2]
+
+
+# ---------------------------------------------------------------------
+# Serve-knob tuning (scripts/autotune.py --kind serve): the serve-only
+# knob surface (batching/slots/early_exit_threshold) persists under
+# kind="serve" and resolves onto ServeConfig with the same precedence
+# rules as model knobs.
+# ---------------------------------------------------------------------
+
+def test_save_serve_knobs_gated_by_kind(reg):
+    key = _save(reg, kind="serve",
+                knobs={"batching": "slot", "slots": 16,
+                       "early_exit_threshold": 0.05})
+    assert key
+    # serve-only knobs are rejected for every other kind
+    with pytest.raises(ValueError, match="unknown tunable knob"):
+        _save(reg, kind="train", knobs={"slots": 16})
+    with pytest.raises(ValueError, match="unknown tunable knob"):
+        _save(reg, kind="eval", knobs={"batching": "slot"})
+
+
+def test_resolve_serve_config_applies_and_pins(reg):
+    from raft_tpu.serve import ServeConfig
+
+    _save(reg, kind="serve",
+          knobs={"batching": "slot", "slots": 16,
+                 "early_exit_threshold": 0.05})
+    tuned, info = tuning.resolve_serve_config(ServeConfig(), path=reg)
+    assert info.tuned
+    assert tuned.batching == "slot" and tuned.slots == 16
+    assert tuned.early_exit_threshold == 0.05
+    assert set(info.applied) == {"batching", "slots",
+                                 "early_exit_threshold"}
+    # explicit user knobs beat the registry (pinned, not overwritten)
+    tuned2, info2 = tuning.resolve_serve_config(
+        ServeConfig(slots=4), path=reg)
+    assert tuned2.slots == 4 and info2.pinned == {"slots": 4}
+    assert "slots" not in info2.applied
+    # no registry entry -> untouched config
+    tuned3, info3 = tuning.resolve_serve_config(
+        ServeConfig(), path=reg.replace("tuning", "absent"))
+    assert not info3.tuned and tuned3 == ServeConfig()
+
+
+def test_resolve_serve_config_env_disable(reg, monkeypatch):
+    from raft_tpu.serve import ServeConfig
+
+    _save(reg, kind="serve", knobs={"slots": 16})
+    monkeypatch.setenv(tuning.ENV_DISABLE, "0")
+    tuned, info = tuning.resolve_serve_config(ServeConfig(), path=reg)
+    assert not info.tuned and tuned == ServeConfig()
+
+
+def test_early_exit_gate():
+    cr = _load_script("check_regression")
+    rec = {"metric": "m", "value": 30.0,
+           "config": {"early_exit_epe_delta": 0.02}}
+    failures, _ = cr.check({"m": [rec]}, max_early_exit_epe_delta=0.05)
+    assert not failures
+    rec2 = {"metric": "m", "value": 30.0,
+            "config": {"early_exit_epe_delta": 0.2}}
+    failures, _ = cr.check({"m": [rec2]}, max_early_exit_epe_delta=0.05)
+    assert failures and "early-exit" in failures[0]
+    # the gate refuses to pass vacuously
+    failures, _ = cr.check({"m": [{"metric": "m", "value": 1.0}]},
+                           max_early_exit_epe_delta=0.05)
+    assert failures and "did not run" in failures[0]
