@@ -28,6 +28,8 @@ _SUBCOMMANDS = {
                     "checkpoint integrity check / resume preview"),
     "lk-compare": ("raft_tpu.cli.lk_compare",
                    "RAFT vs Lucas-Kanade side-by-side"),
+    "lint": ("raft_tpu.cli.lint",
+             "raftlint static analysis (docs/ANALYSIS.md)"),
 }
 
 

@@ -1,0 +1,585 @@
+"""JIT-purity / host-sync checker (rules JIT101..JIT104).
+
+RAFT's hot loop is iterative refinement under ``jax.jit`` — host
+impurity inside traced code is the dominant *silent* perf-regression
+class in a JAX port: a stray ``time.perf_counter()`` becomes a
+trace-time constant (wrong, not slow), ``np.asarray``/``.item()`` on a
+traced value forces a device sync (or a trace error at best), and a
+Python ``if`` on a traced boolean either crashes under jit or silently
+recompiles per branch.
+
+The checker walks functions *reachable from jit call sites* rather than
+flagging whole files, so host-side drivers (``serve/slots.py``'s slot
+dispatcher, the ``make_*`` factories in ``train/step.py``) can freely
+use numpy an inch away from the traced inner functions they build:
+
+- **roots**: first-class function references passed to
+  ``jax.jit`` / ``pmap`` / ``vmap`` / ``grad`` / ``value_and_grad`` /
+  ``checkpoint`` / ``remat`` / ``lax.scan`` / ``cond`` /
+  ``while_loop`` / ``fori_loop`` / ``switch`` / ``map`` (as names,
+  lambdas, or factory calls whose returned inner function is
+  resolved), decorator forms of the same, and every method of an
+  ``nn.Module`` subclass (flax ``apply`` dispatch is not statically
+  resolvable, so Module bodies are traced by definition);
+- **edges**: calls by name, resolved against nested defs, module-level
+  defs, same-class methods, and a cross-module union over the scoped
+  files (imported helpers are called by bare name) — a deliberate
+  over-approximation; suppress the rare false positive inline.
+
+Rules:
+
+- ``JIT101`` host call in traced code: ``time.*``, ``np.random.*``,
+  stdlib ``random.*``, ``print``;
+- ``JIT102`` host sync on a traced value: ``.item()`` / ``.tolist()``
+  / ``float()`` / ``int()`` / ``bool()`` / ``np.asarray`` /
+  ``np.array`` applied to a value *tainted* by a traced argument
+  (static-metadata reads — ``.shape`` / ``.ndim`` / ``.dtype`` /
+  ``len()`` — never taint: they are concrete at trace time);
+- ``JIT103`` ``.block_until_ready()`` outside the profiling utils
+  (``raft_tpu/utils/profiling.py``) — library code must never sync;
+  benches and scripts are out of scope by construction;
+- ``JIT104`` Python ``if`` / ``while`` / ternary on a traced value
+  (same taint; ``if cfg.small:`` and shape branches stay legal).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from raft_tpu.analysis.core import Finding, Workspace
+
+#: Files whose functions can be traced (repo-relative).  The serve slot
+#: program and the train step are single files inside host-heavy
+#: packages; models/ and ops/ are traced almost wall to wall.
+DEFAULT_SCOPE = (
+    "raft_tpu/models",
+    "raft_tpu/ops",
+    "raft_tpu/train/step.py",
+    "raft_tpu/train/loss.py",
+    "raft_tpu/serve/slots.py",
+)
+
+#: Where ``.block_until_ready()`` is legitimate: the profiling helpers
+#: exist to time device work.
+BLOCK_ALLOWED = ("raft_tpu/utils/profiling.py",)
+
+#: Attribute names of jax transforms whose function-typed arguments
+#: become traced roots.
+_TRANSFORMS = {
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "checkpoint",
+    "remat", "scan", "cond", "while_loop", "fori_loop", "switch",
+    "map", "custom_vjp", "custom_jvp", "shard_map", "named_call",
+}
+
+#: Attribute reads that stay concrete under tracing (never propagate
+#: taint, never count as "using" a traced value).
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval",
+                 "sharding", "weak_type"}
+
+#: Builtins whose result is concrete even on traced arguments.
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr",
+                 "id", "repr", "str", "format"}
+
+#: Parameter names that are configuration/static by convention in this
+#: repo: frozen config dataclasses, the flax static bool knobs
+#: (``train``/``test_mode``/``freeze_bn`` drive retraces, not traced
+#: branches), kernel tiling ints (``block_q``/``radius``/``iters``),
+#: and dtype selectors.  Branching on these is legal trace-time
+#: specialization, so they never carry taint.
+_STATIC_PARAM_NAMES = {
+    "self", "cls", "cfg", "config", "model_cfg", "serve_cfg",
+    "train_cfg", "mesh", "axis_name",
+    "train", "training", "test_mode", "freeze_bn", "interpret",
+    "batch_stats",  # pytree-of-stats: `if batch_stats:` is emptiness
+    "iters", "accum", "unroll", "block_q", "block_kv", "radius",
+    "npad", "dtype", "out_dtype", "corr_dtype",
+}
+_STATIC_ANNOS = {"int", "float", "bool", "str", "bytes", "tuple",
+                 "Tuple", "Sequence", "Optional", "Callable"}
+
+#: jnp/jax helpers that return *concrete* (host) values even on traced
+#: arguments — dtype algebra, not array computation.
+_CONCRETE_JNP = {"issubdtype", "result_type", "promote_types",
+                 "finfo", "iinfo", "can_cast", "isdtype", "dtype",
+                 "ndim", "shape", "size"}
+
+#: Call roots whose results are traced arrays when any argument is
+#: tainted at all (the weak→strong upgrade: a jnp op on a traced or
+#: array-valued input yields a traced array).
+_ARRAY_NAMESPACES = {"jnp", "jax", "lax", "nn", "optax"}
+
+#: Taint levels.  WEAK marks values that *may* be traced (parameters
+#: of transitively-reached helpers — often static ints like tile
+#: sizes); STRONG marks values that are arrays under tracing
+#: (parameters of jit-root functions, results of jnp/lax ops on
+#: tainted inputs).  Only STRONG taint fires JIT102/JIT104 — weak
+#: taint exists purely to seed the upgrade rule, which keeps helper
+#: functions with static scalar params quiet without losing real
+#: findings inside them.
+_WEAK, _STRONG = 1, 2
+
+
+def _call_name(func: ast.AST) -> Tuple[Optional[str], List[str]]:
+    """``(root_name, attr_chain)`` of a call target: ``np.random.rand``
+    -> ``("np", ["random", "rand"])``; bare ``print`` -> ``("print",
+    [])``."""
+    chain: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        chain.insert(0, node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, chain
+    return None, chain
+
+
+class _FuncInfo:
+    __slots__ = ("node", "sf", "qualname", "cls", "nested", "parent")
+
+    def __init__(self, node, sf, qualname, cls, parent):
+        self.node = node
+        self.sf = sf
+        self.qualname = qualname
+        self.cls = cls            # enclosing class name or None
+        self.parent = parent      # enclosing _FuncInfo or None
+        self.nested: Dict[str, "_FuncInfo"] = {}
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """Function/class/import tables for one module."""
+
+    def __init__(self, sf):
+        self.sf = sf
+        self.functions: List[_FuncInfo] = []
+        self.toplevel: Dict[str, _FuncInfo] = {}
+        self.methods: Dict[str, List[_FuncInfo]] = {}
+        self.module_classes: Set[str] = set()   # nn.Module subclasses
+        self.imports: Dict[str, str] = {}       # alias -> module path
+        self._cls_stack: List[str] = []
+        self._fn_stack: List[_FuncInfo] = []
+        self.visit(sf.tree)
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.imports[a.asname or a.name.split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            self.imports[a.asname or a.name] = (
+                f"{node.module}.{a.name}" if node.module else a.name)
+
+    def visit_ClassDef(self, node):
+        bases = []
+        for b in node.bases:
+            root, chain = _call_name(b)
+            bases.append(".".join(filter(None, [root] + chain)))
+        if any(b.endswith("Module") or b in self.module_classes
+               for b in bases):
+            self.module_classes.add(node.name)
+        self._cls_stack.append(node.name)
+        self.generic_visit(node)
+        self._cls_stack.pop()
+
+    def _visit_fn(self, node):
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        parent = self._fn_stack[-1] if self._fn_stack else None
+        prefix = (parent.qualname + "." if parent
+                  else (cls + "." if cls else ""))
+        info = _FuncInfo(node, self.sf, prefix + node.name, cls, parent)
+        self.functions.append(info)
+        if parent is not None:
+            parent.nested[node.name] = info
+        elif cls is not None:
+            self.methods.setdefault(node.name, []).append(info)
+        else:
+            self.toplevel[node.name] = info
+        self._fn_stack.append(info)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+
+def _resolve(name: str, scope: Optional[_FuncInfo],
+             idx: _ModuleIndex,
+             global_fns: Dict[str, List[_FuncInfo]]) -> List[_FuncInfo]:
+    """All functions a bare-name reference could mean: nested defs in
+    enclosing scopes, then module level, then the cross-module union."""
+    f = scope
+    while f is not None:
+        if name in f.nested:
+            return [f.nested[name]]
+        f = f.parent
+    if name in idx.toplevel:
+        return [idx.toplevel[name]]
+    return global_fns.get(name, [])
+
+
+def _function_args(call: ast.Call) -> List[ast.AST]:
+    return list(call.args) + [kw.value for kw in call.keywords
+                              if kw.arg in ("fun", "f", "body_fun",
+                                            "cond_fun", "body")]
+
+
+class _TaintChecker(ast.NodeVisitor):
+    """Single-function taint pass: traced params flow through
+    assignments/ops/jnp calls; static-metadata reads do not.  See the
+    ``_WEAK``/``_STRONG`` notes above for the two-level model."""
+
+    def __init__(self, info: _FuncInfo, findings: List[Finding],
+                 param_levels: Dict[str, int]):
+        self.info = info
+        self.findings = findings
+        self.level: Dict[str, int] = dict(param_levels)
+
+    # -- taint propagation --------------------------------------------
+
+    def _lv(self, node: ast.AST) -> int:
+        if node is None:
+            return 0
+        if isinstance(node, ast.Name):
+            return self.level.get(node.id, 0)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return 0
+            return self._lv(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._lv(node.value)
+        if isinstance(node, ast.Call):
+            root, chain = _call_name(node.func)
+            if root in _STATIC_CALLS and not chain:
+                return 0
+            leaf = chain[-1] if chain else root
+            if leaf in _CONCRETE_JNP:
+                return 0
+            arg_lv = max(
+                [self._lv(a) for a in node.args]
+                + [self._lv(kw.value) for kw in node.keywords]
+                + [0])
+            if root in _ARRAY_NAMESPACES and arg_lv:
+                return _STRONG  # jnp op on a traced input → array
+            recv_lv = (self._lv(node.func.value)
+                       if isinstance(node.func, ast.Attribute) else 0)
+            return max(arg_lv, recv_lv)
+        if isinstance(node, ast.BinOp):
+            return max(self._lv(node.left), self._lv(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self._lv(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return max(self._lv(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` is trace-time identity on
+            # the Python object, never a traced-boolean branch.
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return 0
+            return max([self._lv(node.left)]
+                       + [self._lv(c) for c in node.comparators])
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return max([self._lv(e) for e in node.elts] + [0])
+        if isinstance(node, ast.IfExp):
+            return max(self._lv(node.body), self._lv(node.orelse))
+        if isinstance(node, ast.Starred):
+            return self._lv(node.value)
+        return 0
+
+    def _strong(self, node: ast.AST) -> bool:
+        return self._lv(node) >= _STRONG
+
+    def _taint_target(self, tgt: ast.AST, lv: int) -> None:
+        if isinstance(tgt, ast.Name):
+            if lv > self.level.get(tgt.id, 0):
+                self.level[tgt.id] = lv
+            elif lv == 0:
+                self.level.pop(tgt.id, None)  # rebound to untainted
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._taint_target(e, lv)
+        elif isinstance(tgt, ast.Starred):
+            self._taint_target(tgt.value, lv)
+
+    def visit_Assign(self, node):
+        lv = self._lv(node.value)
+        for t in node.targets:
+            self._taint_target(t, lv)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        lv = self._lv(node.value)
+        if lv:
+            self._taint_target(node.target, lv)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._taint_target(node.target, self._lv(node.value))
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        lv = self._lv(node.iter)
+        if lv:
+            self._taint_target(node.target, lv)
+        self.generic_visit(node)
+
+    def _skip_nested(self, node):
+        # Nested defs are separate reachability nodes; don't double-walk.
+        return None
+
+    visit_FunctionDef = _skip_nested
+    visit_AsyncFunctionDef = _skip_nested
+
+    # -- findings ------------------------------------------------------
+
+    def _flag(self, rule, node, detail, message):
+        self.findings.append(Finding(
+            rule=rule, path=self.info.sf.relpath, line=node.lineno,
+            detail=f"{self.info.qualname}:{detail}", message=message))
+
+    def visit_Call(self, node):
+        root, chain = _call_name(node.func)
+        dotted = ".".join(filter(None, [root] + chain))
+        qn = self.info.qualname
+        # JIT101: host-state calls that become trace-time constants.
+        if root == "time" and chain:
+            self._flag("JIT101", node, dotted,
+                       f"host clock call `{dotted}()` inside traced "
+                       f"function `{qn}` is evaluated ONCE at trace "
+                       "time (a frozen constant, not a timing)")
+        elif root in ("np", "numpy", "onp") and chain[:1] == ["random"]:
+            self._flag("JIT101", node, dotted,
+                       f"`{dotted}()` inside traced function `{qn}` "
+                       "draws host randomness at trace time — every "
+                       "execution replays the same draw; use "
+                       "jax.random with an explicit key")
+        elif root == "random" and chain:
+            self._flag("JIT101", node, dotted,
+                       f"stdlib `{dotted}()` inside traced function "
+                       f"`{qn}` is trace-time host randomness")
+        elif root == "print" and not chain:
+            self._flag("JIT101", node, "print",
+                       f"`print` inside traced function `{qn}` fires "
+                       "at trace time only; use jax.debug.print for "
+                       "runtime values")
+        # JIT102: forced host syncs on traced values.
+        if root in ("float", "int", "bool", "complex") and not chain \
+                and node.args and self._strong(node.args[0]):
+            self._flag("JIT102", node, f"{root}()",
+                       f"`{root}()` on a traced value in `{qn}` forces "
+                       "a trace error / host sync; keep it as an array "
+                       "(static metadata like .shape does not need "
+                       "this)")
+        if root in ("np", "numpy", "onp") and chain and \
+                chain[-1] in ("asarray", "array") and node.args and \
+                self._strong(node.args[0]):
+            self._flag("JIT102", node, dotted,
+                       f"`{dotted}()` on a traced value in `{qn}` "
+                       "pulls the array to host mid-trace; use jnp")
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("item", "tolist", "numpy") and \
+                not node.args and self._strong(node.func.value):
+            self._flag("JIT102", node, node.func.attr,
+                       f"`.{node.func.attr}()` on a traced value in "
+                       f"`{qn}` is a device sync inside the traced "
+                       "region")
+        self.generic_visit(node)
+
+    # JIT104: Python control flow on traced values.
+    def _check_branch(self, node, kind: str):
+        test = getattr(node, "test", None)
+        if test is not None and self._strong(test):
+            names = sorted({n.id for n in ast.walk(test)
+                            if isinstance(n, ast.Name)
+                            and self.level.get(n.id, 0) >= _STRONG})
+            self._flag("JIT104", node, f"{kind}:{','.join(names)}",
+                       f"Python `{kind}` on traced value(s) "
+                       f"{names} in `{self.info.qualname}` — traced "
+                       "booleans cannot drive Python control flow; "
+                       "use lax.cond/jnp.where (shape/config branches "
+                       "are fine and not flagged)")
+
+    def visit_If(self, node):
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_branch(node, "ifexp")
+        self.generic_visit(node)
+
+
+def _traced_params(info: _FuncInfo, is_root: bool) -> Dict[str, int]:
+    """Parameter taint levels: everything except self/cls, known
+    config names, and scalar/static annotations.  Root functions get
+    STRONG params (jit traces their array arguments); transitively
+    reached helpers get WEAK (their params are often static tile
+    sizes passed down — only jnp-op results upgrade to STRONG
+    there)."""
+    out: Dict[str, int] = {}
+    level = _STRONG if is_root else _WEAK
+    args = info.node.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        name = a.arg
+        if name in _STATIC_PARAM_NAMES or name.endswith("_cfg") \
+                or name.endswith("_config"):
+            continue
+        anno = a.annotation
+        if anno is not None:
+            root, chain = _call_name(anno)
+            label = ".".join(filter(None, [root] + chain))
+            if (root in _STATIC_ANNOS
+                    or (label and "Config" in label)):
+                continue
+        out[name] = level
+    return out
+
+
+def check(ws: Workspace,
+          scope: Sequence[str] = DEFAULT_SCOPE,
+          block_scope: Sequence[str] = ("raft_tpu",),
+          block_allowed: Sequence[str] = BLOCK_ALLOWED) -> List[Finding]:
+    findings: List[Finding] = []
+    indexes: List[_ModuleIndex] = []
+    for sf in ws.glob_py(*scope, exclude=("tests/",)):
+        if sf.tree is None:
+            findings.append(Finding(
+                "LINT000", sf.relpath, 1, "parse-error",
+                f"file does not parse: {sf.parse_error}"))
+            continue
+        indexes.append(_ModuleIndex(sf))
+
+    # Cross-module union index (imported helpers are called by bare
+    # name; methods by attribute name).
+    global_fns: Dict[str, List[_FuncInfo]] = {}
+    for idx in indexes:
+        for info in idx.functions:
+            global_fns.setdefault(info.node.name, []).append(info)
+
+    # Roots: transform call sites + decorators + nn.Module methods.
+    roots: List[_FuncInfo] = []
+
+    def add_func_expr(expr, scope_fn, idx):
+        """Resolve a function-typed argument expression to root(s)."""
+        if isinstance(expr, ast.Lambda):
+            return  # walked inline by the enclosing visit
+        if isinstance(expr, ast.Name):
+            roots.extend(_resolve(expr.id, scope_fn, idx, global_fns))
+        elif isinstance(expr, ast.Attribute):
+            # self.method / module.fn
+            roots.extend(global_fns.get(expr.attr, []))
+        elif isinstance(expr, ast.Call):
+            # factory: jax.jit(make_encode_fn(cfg)) — the factory's
+            # returned inner function(s) are the traced program.
+            root, chain = _call_name(expr.func)
+            if root is not None:
+                name = chain[-1] if chain else root
+                for factory in _resolve(name, scope_fn, idx,
+                                        global_fns):
+                    for ret in ast.walk(factory.node):
+                        if isinstance(ret, ast.Return) and \
+                                ret.value is not None:
+                            for n in ast.walk(ret.value):
+                                if isinstance(n, ast.Name) and \
+                                        n.id in factory.nested:
+                                    roots.append(
+                                        factory.nested[n.id])
+
+    for idx in indexes:
+        for cls_name in idx.module_classes:
+            for infos in idx.methods.values():
+                roots.extend(i for i in infos if i.cls == cls_name)
+        for info in idx.functions:
+            for deco in info.node.decorator_list:
+                d = deco.func if isinstance(deco, ast.Call) else deco
+                root, chain = _call_name(d)
+                names = set(filter(None, [root] + chain))
+                if names & _TRANSFORMS:
+                    roots.append(info)
+                if isinstance(deco, ast.Call) and \
+                        root in ("partial", "functools"):
+                    for a in deco.args:
+                        r2, c2 = _call_name(a)
+                        if set(filter(None, [r2] + c2)) & _TRANSFORMS:
+                            roots.append(info)
+        containing: List[Tuple[Optional[_FuncInfo], ast.Call]] = []
+
+        class _CallCollector(ast.NodeVisitor):
+            def __init__(self):
+                self._stack: List[_FuncInfo] = []
+
+            def _fn(self, node):
+                info = next(i for i in idx.functions if i.node is node)
+                self._stack.append(info)
+                self.generic_visit(node)
+                self._stack.pop()
+
+            visit_FunctionDef = _fn
+            visit_AsyncFunctionDef = _fn
+
+            def visit_Call(self, node):
+                root, chain = _call_name(node.func)
+                name = chain[-1] if chain else root
+                if name in _TRANSFORMS:
+                    containing.append(
+                        (self._stack[-1] if self._stack else None,
+                         node))
+                self.generic_visit(node)
+
+        _CallCollector().visit(idx.sf.tree)
+        for scope_fn, call in containing:
+            for arg in _function_args(call):
+                add_func_expr(arg, scope_fn, idx)
+
+    # Reachability: BFS over call-by-name edges.
+    traced: Set[int] = set()
+    queue = list(roots)
+    info_by_node = {id(i.node): i for idx in indexes
+                    for i in idx.functions}
+    idx_by_file = {idx.sf.relpath: idx for idx in indexes}
+    while queue:
+        info = queue.pop()
+        if id(info.node) in traced:
+            continue
+        traced.add(id(info.node))
+        idx = idx_by_file[info.sf.relpath]
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call):
+                root, chain = _call_name(node.func)
+                name = chain[-1] if chain else root
+                if name is None or name in _TRANSFORMS:
+                    continue
+                for callee in _resolve(name, info, idx, global_fns):
+                    if id(callee.node) not in traced:
+                        queue.append(callee)
+
+    # Purity pass over every traced function.
+    root_ids = {id(r.node) for r in roots}
+    for node_id in traced:
+        info = info_by_node[node_id]
+        checker = _TaintChecker(
+            info, findings,
+            _traced_params(info, is_root=node_id in root_ids))
+        for stmt in info.node.body:
+            checker.visit(stmt)
+
+    # JIT103: .block_until_ready() anywhere in library code outside the
+    # profiling allowlist (scripts/benches are not scanned).
+    for sf in ws.glob_py(*block_scope, exclude=("tests/",)):
+        if sf.relpath in block_allowed or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "block_until_ready":
+                findings.append(Finding(
+                    "JIT103", sf.relpath, node.lineno,
+                    "block_until_ready",
+                    "`.block_until_ready()` outside "
+                    f"{'/'.join(block_allowed)} — library code must "
+                    "not force device syncs; time with the profiling "
+                    "utils or let the caller sync"))
+    return findings

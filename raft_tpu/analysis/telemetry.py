@@ -1,0 +1,411 @@
+"""Telemetry-contract checker (rules TEL301..TEL305).
+
+The repo's observability contract lives in three places that only
+convention keeps in sync: the emission sites
+(``registry.counter/gauge/histogram``, ``sink.emit``, ``span``), the
+catalog in ``docs/OBSERVABILITY.md``, and the consumers
+(``scripts/telemetry_summary.py`` folding, ``check_regression.py``
+gates).  Dashboards fail *silently* when these drift — a renamed metric
+doesn't error, it just flatlines.
+
+Matching is deliberately asymmetric:
+
+- **documented?** is lenient — a metric/event counts as documented if
+  its name appears in a backtick span anywhere in the doc (catalog
+  table, prose, triage table), with ``{label}`` suffixes stripped.
+  Prose like "check ``serve_retry`` events" is documentation.
+- **still emitted?** is strict on the doc side (only names in actual
+  catalog-table rows — header ``| metric |`` / ``| event |`` — assert
+  existence) and lenient on the code side (any matching ``raft_*``
+  string literal anywhere in the scanned tree counts, including
+  f-string literal prefixes and ``span("name")`` → ``name_seconds``
+  derivations), so refactors that route a name through a variable
+  don't false-positive.
+
+Rules:
+
+- ``TEL301`` metric emitted with a literal name the doc never
+  mentions;
+- ``TEL302`` metric named in a catalog-table row that nothing in the
+  code can emit anymore (stale doc);
+- ``TEL303`` / ``TEL304`` — same pair for JSONL events
+  (``sink.emit("name", ...)`` vs the event-schema table);
+- ``TEL305`` ``check_regression.py`` gates on a record key
+  (``cfg.get("k")`` / ``newest.get("k")``) that no producer script
+  ever writes — a gate reading a key nobody emits passes vacuously
+  forever.
+
+``fix_documentation`` implements ``lint_repo.py --fix`` for the
+mechanical half of this: appending placeholder rows for undocumented
+names to the right table.  Stale rows and prose are judgment calls and
+stay manual.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from raft_tpu.analysis.core import Finding, Workspace
+
+DOC_PATH = "docs/OBSERVABILITY.md"
+#: Code scanned for emissions.  tests/ and the analysis package itself
+#: are excluded (both quote metric names without emitting them).
+CODE_SCOPE = ("raft_tpu", "scripts")
+CODE_EXCLUDE = ("tests/", "raft_tpu/analysis/")
+GATE_PATH = "scripts/check_regression.py"
+#: Producers whose literals satisfy TEL305 gate keys: the summary
+#: folding and the bench emitters — scripts/ plus the CLIs that print
+#: bench-format records (``raft_tpu/cli/evaluate.py``'s sweep stamps).
+#: The gate file itself is explicitly NOT a producer (see check()).
+PRODUCER_SCOPE = ("scripts", "raft_tpu/cli")
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+#: What a metric/event name looks like (vs a path / flag / expression
+#: that happens to sit in backticks).
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_METRIC_RE = re.compile(r"^raft_[a-z0-9_]+$")
+
+
+def _strip_labels(token: str) -> str:
+    return re.sub(r"\{[^}]*\}", "", token).strip()
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _fstring_prefix(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = _str_const(node.values[0])
+        if first:
+            return first
+    return None
+
+
+class _Emission:
+    __slots__ = ("name", "path", "line", "kind", "prefix")
+
+    def __init__(self, name, path, line, kind, prefix=False):
+        self.name = name
+        self.path = path
+        self.line = line
+        self.kind = kind      # "counter"/"gauge"/"histogram"/"event"
+        self.prefix = prefix  # True when name is an f-string prefix
+
+
+def _module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings (``EVENT =
+    "trace_span"`` in obs/trace.py is the motivating case)."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            val = _str_const(node.value)
+            if val is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = val
+    return out
+
+
+def collect_emissions(ws: Workspace,
+                      scope: Sequence[str] = CODE_SCOPE,
+                      exclude: Sequence[str] = CODE_EXCLUDE,
+                      ) -> Tuple[List[_Emission], Set[str], Set[str]]:
+    """``(emissions, literal_pool, prefix_pool)``.
+
+    ``emissions`` have resolvable names (literal / module constant /
+    f-string prefix); the pools additionally hold every bare ``raft_*``
+    string literal in scope, so names routed through variables and
+    function defaults still count as emitted for the staleness rules.
+    """
+    emissions: List[_Emission] = []
+    literal_pool: Set[str] = set()
+    prefix_pool: Set[str] = set()
+    for sf in ws.glob_py(*scope, exclude=tuple(exclude)):
+        if sf.tree is None:
+            continue
+        consts = _module_str_constants(sf.tree)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    _METRIC_RE.match(node.value) and \
+                    node.value != "raft_tpu":
+                literal_pool.add(node.value)
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if attr in ("counter", "gauge", "histogram") and node.args:
+                arg = node.args[0]
+                name = _str_const(arg)
+                if name is None and isinstance(arg, ast.Name):
+                    name = consts.get(arg.id)
+                if name is not None:
+                    emissions.append(_Emission(
+                        name, sf.relpath, node.lineno, attr))
+                else:
+                    pref = _fstring_prefix(arg)
+                    if pref and _METRIC_RE.match(pref.rstrip("_")):
+                        emissions.append(_Emission(
+                            pref, sf.relpath, node.lineno, attr,
+                            prefix=True))
+                        prefix_pool.add(pref)
+            elif attr in ("span", "trace_span") and node.args:
+                name = _str_const(node.args[0])
+                if name is not None and not isinstance(
+                        f, ast.Attribute):
+                    # span(name) times into <name>_seconds and is a
+                    # metric surface of its own (trace_span children
+                    # fold into the trace_span event, not here).
+                    if attr == "span":
+                        derived = (name if name.endswith("_seconds")
+                                   else f"{name}_seconds")
+                        emissions.append(_Emission(
+                            derived, sf.relpath, node.lineno,
+                            "histogram"))
+                        literal_pool.add(derived)
+            elif attr == "emit" and node.args and \
+                    isinstance(f, ast.Attribute):
+                arg = node.args[0]
+                name = _str_const(arg)
+                if name is None and isinstance(arg, ast.Name):
+                    name = consts.get(arg.id)
+                if name is not None:
+                    emissions.append(_Emission(
+                        name, sf.relpath, node.lineno, "event"))
+    return emissions, literal_pool, prefix_pool
+
+
+# ---------------------------------------------------------------------
+# doc parsing
+# ---------------------------------------------------------------------
+
+
+class DocCatalog:
+    """``docs/OBSERVABILITY.md`` parsed two ways: the lenient
+    any-backtick token set and the strict catalog-table rows."""
+
+    def __init__(self, text: str):
+        self.tokens: Set[str] = set()
+        #: name -> 1-based doc line, from rows of ``| metric |`` tables
+        self.metric_rows: Dict[str, int] = {}
+        #: same, from rows of ``| event |`` tables
+        self.event_rows: Dict[str, int] = {}
+        header = None   # "metric" | "event" | other
+        for i, line in enumerate(text.splitlines(), start=1):
+            stripped = line.strip()
+            for tok in _BACKTICK_RE.findall(line):
+                tok = _strip_labels(tok)
+                if tok:
+                    self.tokens.add(tok)
+            if not stripped.startswith("|"):
+                header = None
+                continue
+            cells = [c.strip() for c in stripped.strip("|").split("|")]
+            if not cells:
+                continue
+            first = cells[0].lower()
+            if first in ("metric", "event"):
+                header = first
+                continue
+            if set(first) <= {"-", " ", ":"}:
+                continue
+            if header is None:
+                continue
+            rows = (self.metric_rows if header == "metric"
+                    else self.event_rows)
+            for tok in _BACKTICK_RE.findall(cells[0]):
+                name = _strip_labels(tok)
+                if _NAME_RE.match(name):
+                    rows.setdefault(name, i)
+
+    def documents(self, name: str, prefix: bool = False) -> bool:
+        if prefix:
+            return any(t.startswith(name) for t in self.tokens)
+        return name in self.tokens
+
+
+# ---------------------------------------------------------------------
+# checks
+# ---------------------------------------------------------------------
+
+
+#: Receiver names that hold a bench record (or its ``config`` block)
+#: inside the gate — only ``.get()`` reads off these are contract keys.
+#: Other receivers (``report.get`` in the lint gate, dict helpers) are
+#: not reading the bench-record schema.
+_RECORD_RECEIVERS = {"cfg", "config", "newest", "rec", "record", "r"}
+
+
+def _gate_keys(sf) -> List[Tuple[str, int]]:
+    """Literal keys the regression gate reads off bench records:
+    ``cfg.get("k")`` / ``newest.get("k")`` / ``r.get("k")``."""
+    out: List[Tuple[str, int]] = []
+    if sf is None or sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and node.args and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in _RECORD_RECEIVERS:
+            key = _str_const(node.args[0])
+            if key is not None and _NAME_RE.match(key):
+                out.append((key, node.lineno))
+    return out
+
+
+def check(ws: Workspace,
+          doc_path: str = DOC_PATH,
+          scope: Sequence[str] = CODE_SCOPE,
+          exclude: Sequence[str] = CODE_EXCLUDE,
+          gate_path: str = GATE_PATH,
+          producer_scope: Sequence[str] = PRODUCER_SCOPE,
+          ) -> List[Finding]:
+    findings: List[Finding] = []
+    doc_sf = ws.get(doc_path)
+    if doc_sf is None:
+        return [Finding("TEL302", doc_path, 1, "missing-doc",
+                        f"{doc_path} does not exist — the telemetry "
+                        "catalog is the contract this rule checks")]
+    doc = DocCatalog(doc_sf.text)
+    emissions, literal_pool, prefix_pool = collect_emissions(
+        ws, scope, exclude)
+
+    # TEL301 / TEL303: emitted but undocumented (dedup per name).
+    seen: Set[str] = set()
+    for e in emissions:
+        if e.name in seen:
+            continue
+        seen.add(e.name)
+        if doc.documents(e.name, prefix=e.prefix):
+            continue
+        if e.kind == "event":
+            findings.append(Finding(
+                "TEL303", e.path, e.line, e.name,
+                f"event `{e.name}` is emitted here but never "
+                f"mentioned in {doc_path}; add a schema-table row "
+                "(or prose) — undocumented events rot into "
+                "unparseable logs"))
+        else:
+            findings.append(Finding(
+                "TEL301", e.path, e.line,
+                e.name + ("*" if e.prefix else ""),
+                f"{e.kind} `{e.name}{'…' if e.prefix else ''}` is "
+                f"emitted here but never mentioned in {doc_path}; "
+                "add a catalog row — dashboards can't find what the "
+                "doc doesn't name"))
+
+    # TEL302 / TEL304: documented in a catalog table, no longer
+    # emittable from code.
+    emitted_names = {e.name for e in emissions} | literal_pool
+
+    def emittable(name: str) -> bool:
+        if name in emitted_names:
+            return True
+        return any(name.startswith(p) for p in prefix_pool)
+
+    for name, line in sorted(doc.metric_rows.items()):
+        if _METRIC_RE.match(name) and not emittable(name):
+            findings.append(Finding(
+                "TEL302", doc_path, line, name,
+                f"catalog row documents metric `{name}` but no "
+                "emission site or string literal in "
+                f"{'/'.join(scope)} can produce it — stale doc or "
+                "renamed metric"))
+    event_names = {e.name for e in emissions if e.kind == "event"}
+    for name, line in sorted(doc.event_rows.items()):
+        if name not in event_names and name not in literal_pool:
+            findings.append(Finding(
+                "TEL304", doc_path, line, name,
+                f"schema row documents event `{name}` but nothing "
+                "emits it — stale doc or renamed event"))
+
+    # TEL305: regression-gate keys nobody produces.
+    gate_sf = ws.get(gate_path)
+    if gate_sf is not None and gate_sf.tree is not None:
+        pool: Set[str] = set()
+        for sf in ws.glob_py(*producer_scope, exclude=("tests/",)):
+            # The gate file is NOT its own producer: counting its
+            # literals would put every `.get("k")` key into the pool
+            # and make this rule vacuously green forever.
+            if sf.relpath == gate_path or sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    pool.add(node.value)
+                pref = _fstring_prefix(node)
+                if pref:
+                    pool.add(pref.rstrip("_"))
+        seen_keys: Set[str] = set()
+        for key, line in _gate_keys(gate_sf):
+            if key in seen_keys:
+                continue
+            seen_keys.add(key)
+            if key not in pool:
+                findings.append(Finding(
+                    "TEL305", gate_path, line, key,
+                    f"gate reads record key `{key}` that no producer "
+                    f"under {'/'.join(producer_scope)} ever writes — "
+                    "the check passes vacuously forever"))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# --fix: mechanical doc sync
+# ---------------------------------------------------------------------
+
+
+def fix_documentation(ws: Workspace, findings: Sequence[Finding],
+                      doc_path: str = DOC_PATH) -> Tuple[str, int]:
+    """Append placeholder rows for TEL301/TEL303 findings to the last
+    matching catalog table in the doc.  Returns ``(new_text, n_rows)``
+    — the caller writes the file.  Only the *mechanical* direction is
+    automated; stale rows (TEL302/TEL304) need human judgment."""
+    doc_sf = ws.get(doc_path)
+    if doc_sf is None:
+        return "", 0
+    lines = doc_sf.text.splitlines()
+
+    def last_row_of_table(kind: str) -> Optional[int]:
+        """Index AFTER the last row of the last ``| kind |`` table."""
+        header = None
+        end = None
+        for i, line in enumerate(lines):
+            stripped = line.strip()
+            if not stripped.startswith("|"):
+                header = None
+                continue
+            first = stripped.strip("|").split("|")[0].strip().lower()
+            if first == kind:
+                header = kind
+                continue
+            if header == kind:
+                end = i + 1
+        return end
+
+    inserts: List[Tuple[int, str]] = []
+    for f in findings:
+        name = f.detail.rstrip("*")
+        if f.rule == "TEL301":
+            at = last_row_of_table("metric")
+            row = (f"| `{name}` | counter/gauge | _added by raftlint "
+                   f"--fix from `{f.path}:{f.line}`; describe me_ |")
+        elif f.rule == "TEL303":
+            at = last_row_of_table("event")
+            row = (f"| `{name}` | see `{f.path}:{f.line}` | _added by "
+                   "raftlint --fix; describe fields + cadence_ |")
+        else:
+            continue
+        if at is not None:
+            inserts.append((at, row))
+    # apply bottom-up so earlier indices stay valid
+    for at, row in sorted(inserts, key=lambda t: -t[0]):
+        lines.insert(at, row)
+    return "\n".join(lines) + "\n", len(inserts)

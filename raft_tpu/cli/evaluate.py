@@ -183,10 +183,25 @@ def main(argv=None):
         kwargs = dict(roots[args.dataset])
         if args.dataset == "kitti":
             kwargs["bucket"] = not args.no_bucket
-        evaluate.evaluate_early_exit_delta(
+        result = evaluate.evaluate_early_exit_delta(
             variables, model_cfg, args.early_exit_threshold,
             dataset=args.dataset, iters=iters,
             batch_size=args.eval_batch, **kwargs)
+        # Bench-format record so the sweep rides the BENCH series:
+        # check_regression.py --max-early-exit-epe-delta reads the raw
+        # arm dict (config.early_exit_delta_vs_full) off this record.
+        import json
+        print(json.dumps({
+            "metric": f"eval_early_exit_{args.dataset}_iters{iters}",
+            "value": 1.0,
+            "unit": "pass",
+            "vs_baseline": 0.0,
+            "config": {
+                "early_exit_delta_vs_full": result["delta_vs_full"],
+                "thresholds": result["thresholds"],
+                "per_threshold": result["per_threshold"],
+            },
+        }))
         return
 
     if args.epe_delta:

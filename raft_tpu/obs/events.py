@@ -65,7 +65,9 @@ class EventSink:
         (forensic bundles, stack dumps) land next to the JSONL."""
         return self._dir
 
-    def _ensure_open(self):
+    def _ensure_open_locked(self):
+        # Caller holds self._lock (the *_locked suffix is the repo's
+        # lock-discipline convention — see docs/ANALYSIS.md, LOCK201).
         if self._fh is None:
             os.makedirs(self._dir, exist_ok=True)
             self._process = _process_index()
@@ -82,7 +84,7 @@ class EventSink:
         if self._dir is None:
             return
         with self._lock:
-            fh = self._ensure_open()
+            fh = self._ensure_open_locked()
             rec = {"event": event, "t_wall": time.time(),
                    "t_mono": time.perf_counter(),
                    "process": self._process}

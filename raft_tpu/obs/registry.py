@@ -77,8 +77,10 @@ class _Metric:
     def _enabled(self) -> bool:
         return self._registry is None or self._registry.enabled
 
-    def _guard(self, key: tuple) -> tuple:
-        """Cardinality guard (caller holds ``self._lock``): an unseen
+    def _guard_locked(self, key: tuple) -> tuple:
+        """Cardinality guard; caller holds ``self._lock`` (the
+        ``*_locked`` suffix is the repo's lock-discipline convention —
+        see docs/ANALYSIS.md, LOCK201): an unseen
         label set past the cap folds into ``overflow="true"`` — the
         series count stays bounded, the recorded totals stay honest."""
         if key in self._values or len(self._values) < self._max_labelsets:
@@ -114,7 +116,7 @@ class Counter(_Metric):
             return
         key = _label_key(labels)
         with self._lock:
-            key = self._guard(key)
+            key = self._guard_locked(key)
             self._values[key] = self._values.get(key, 0) + n
 
     def value(self, **labels) -> float:
@@ -130,7 +132,7 @@ class Gauge(_Metric):
             return
         key = _label_key(labels)
         with self._lock:
-            key = self._guard(key)
+            key = self._guard_locked(key)
             self._values[key] = float(v)
 
     def value(self, **labels) -> Optional[float]:
@@ -162,7 +164,7 @@ class Histogram(_Metric):
             return
         key = _label_key(labels)
         with self._lock:
-            key = self._guard(key)
+            key = self._guard_locked(key)
             st = self._values.get(key)
             if st is None:
                 st = self._values[key] = _HistState(self.reservoir)

@@ -375,6 +375,12 @@ class Tracer:
         self._sink = sink
         self._rand = random.Random(seed)
         self._rand_lock = threading.Lock()
+        # Dropped-trace ring: every read/write goes through
+        # self._dropped_lock (lock discipline checked by raftlint
+        # LOCK201 — docs/ANALYSIS.md).  The deque's own maxlen bound is
+        # not a substitute for the lock: emit_recent_dropped snapshots
+        # under the lock, then flushes each state under ITS state.lock
+        # (never both at once, so no order edge — LOCK202).
         self._dropped = deque(maxlen=max(int(keep_dropped), 1))
         self._dropped_lock = threading.Lock()
 
@@ -512,6 +518,11 @@ def active_profile() -> Optional[str]:
 # process-default tracer
 # ---------------------------------------------------------------------------
 
+# Double-checked singleton: the unlocked fast-path read is safe because
+# CPython guarantees atomic reference loads and a Tracer is fully
+# constructed before being published; all WRITES go through
+# _default_lock (same discipline as obs/events.py's default sink —
+# docs/ANALYSIS.md).
 _default: Optional[Tracer] = None
 _default_lock = threading.Lock()
 

@@ -446,7 +446,7 @@ class ReplicaFleet:
                     warming.warmup(self.fleet_cfg.warmup_shapes)
                 canary = self._canary(warming)
             except Exception as e:
-                self._abort_update(warming, e)
+                self._abort_update_locked(warming, e)
                 if isinstance(e, WeightUpdateError):
                     raise
                 raise WeightUpdateError(
@@ -479,7 +479,7 @@ class ReplicaFleet:
                                  timeout=self.fleet_cfg.drain_timeout_s)
                     flipped.append(r.name)
             except Exception as e:
-                self._abort_update(warming, e, flipped=flipped)
+                self._abort_update_locked(warming, e, flipped=flipped)
                 raise WeightUpdateError(
                     f"weight update failed mid-roll (flipped: "
                     f"{flipped}; unflipped replicas rebuild onto the "
@@ -497,8 +497,11 @@ class ReplicaFleet:
             self._sink.emit("fleet_weight_update", **report)
             return report
 
-    def _abort_update(self, warming, exc,
-                      flipped: Optional[list] = None) -> None:
+    def _abort_update_locked(self, warming, exc,
+                             flipped: Optional[list] = None) -> None:
+        # Caller holds self._update_lock (both call sites sit inside
+        # update_weights' critical section; the *_locked suffix is the
+        # lock-discipline convention — docs/ANALYSIS.md, LOCK201).
         self._warming = None
         if warming is not None:
             try:

@@ -109,6 +109,14 @@ def parse_args(argv=None):
                         "from the per-hardware tuning registry "
                         "(scripts/autotune.py); keeps a BENCH series "
                         "from silently drifting back to hand-set knobs")
+    p.add_argument("--lint-report", default=None, metavar="PATH",
+                   help="fail when the raftlint JSON report at PATH "
+                        "(scripts/lint_repo.py --json, or `python -m "
+                        "raft_tpu lint --json`) carries non-baselined "
+                        "findings; ALSO fails when PATH is missing or "
+                        "not a raftlint report — lint silently not "
+                        "running must not look like lint passing "
+                        "(docs/ANALYSIS.md)")
     p.add_argument("--tiny", action="store_true",
                    help="self-test on synthetic series (CPU smoke; "
                         "exercises the pass, drop and nonfinite paths)")
@@ -292,6 +300,35 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
     return failures, report
 
 
+def lint_gate(path):
+    """Failure list from a raftlint JSON report.  Three ways to fail:
+    the report is missing/unreadable, it is not a raftlint report, or
+    it carries non-baselined findings.  A clean report (``total: 0``)
+    passes; an ABSENT report does not — the gate must distinguish
+    "raftlint ran and found nothing" from "raftlint never ran"."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from raft_tpu.analysis.core import load_report
+
+    report, err = load_report(path)
+    if report is None:
+        return [f"lint gate: {err} — refusing to pass without a "
+                "raftlint run (python -m raft_tpu lint --json PATH)"]
+    findings = report.get("findings")
+    total = report.get("total")
+    n = total if isinstance(total, int) else len(findings)
+    if n <= 0:
+        return []
+    by_rule = report.get("counts_by_rule") or {}
+    head = "; ".join(
+        "{}:{}:{} {}".format(f.get("rule"), f.get("path"),
+                             f.get("line"), f.get("message", ""))[:160]
+        for f in findings[:3] if isinstance(f, dict))
+    return [f"lint gate: {n} non-baselined raftlint finding(s) "
+            f"({json.dumps(by_rule)}) — fix them or baseline with a "
+            f"justification (docs/ANALYSIS.md). First: {head}"]
+
+
 def _selftest() -> int:
     """The gate gating itself: synthetic series through the real
     file-loading path."""
@@ -400,6 +437,34 @@ def _selftest() -> int:
          run([30.0, 31.0, 30.5],
              last_cfg={"early_exit_epe_delta": 9.0}), False),
     ]
+
+    def run_lint(payload):
+        """Lint-gate case through the real file path.  ``payload``:
+        None = no file on disk; str = raw file contents; dict = a
+        report skeleton (tool/findings/total filled in by the caller)."""
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "lint.json")
+            if payload is not None:
+                with open(p, "w") as f:
+                    f.write(payload if isinstance(payload, str)
+                            else json.dumps(payload))
+            return lint_gate(p), []
+
+    finding = {"rule": "JIT101", "path": "raft_tpu/models/raft.py",
+               "line": 7, "detail": "time.time",
+               "message": "host call inside a jit-traced function"}
+    cases += [
+        ("lint clean report passes",
+         run_lint({"tool": "raftlint", "findings": [], "total": 0}),
+         False),
+        ("lint new finding fails",
+         run_lint({"tool": "raftlint", "findings": [finding],
+                   "counts_by_rule": {"JIT101": 1}, "total": 1}), True),
+        ("lint missing report fails", run_lint(None), True),
+        ("lint garbage report fails", run_lint("not json {"), True),
+        ("lint wrong-tool report fails",
+         run_lint({"tool": "flake8", "findings": []}), True),
+    ]
     bad = [name for name, (failures, _), want_fail in cases
            if bool(failures) != want_fail]
     print(json.dumps({
@@ -418,7 +483,7 @@ def main(argv=None):
         return _selftest()
     paths = args.paths or sorted(
         glob.glob(os.path.join(REPO, "BENCH_*.json")))
-    if not paths:
+    if not paths and not args.lint_report:
         raise SystemExit("no input records (no BENCH_*.json found and "
                          "no paths given)")
     failures, report = check(build_series(paths),
@@ -433,6 +498,8 @@ def main(argv=None):
                                  args.max_critical_path_ms),
                              max_early_exit_epe_delta=(
                                  args.max_early_exit_epe_delta))
+    if args.lint_report:
+        failures.extend(lint_gate(args.lint_report))
     print(json.dumps({"ok": not failures, "failures": failures,
                       "checked": report}))
     if failures:

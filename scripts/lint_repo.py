@@ -1,0 +1,138 @@
+"""Repo-wide raftlint sweep as a bench-format record.
+
+Thin wrapper over ``python -m raft_tpu lint`` (raft_tpu/analysis/;
+rule catalog in docs/ANALYSIS.md) that folds the run into the same
+one-line JSON shape every other measurement tool here emits, so the
+lint count rides the BENCH series and ``scripts/check_regression.py``
+can gate it two ways:
+
+- as a bench record (``metric: raftlint_findings``, value = active
+  finding count — a flat-zero series any non-zero newest record
+  visibly breaks), and
+- as the full raftlint report (``--report PATH``), the input
+  ``check_regression.py --lint-report`` validates structurally so a
+  vanished lint run cannot pass vacuously.
+
+``--fix`` automates the one mechanical repair: appending placeholder
+catalog rows to docs/OBSERVABILITY.md for undocumented emissions
+(TEL301/TEL303).  Stale rows, lock violations and jit impurities need
+human judgment and are never auto-edited.
+
+::
+
+    python scripts/lint_repo.py --json            # bench record line
+    python scripts/lint_repo.py --report lint.json
+    python scripts/lint_repo.py --fix             # doc-sync, then lint
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from raft_tpu.analysis import (BASELINE_PATH, Workspace, files_scanned,
+                               load_baseline, make_report, run_checks,
+                               split_findings)
+from raft_tpu.analysis import telemetry as _telemetry
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="raftlint sweep -> bench-format record")
+    p.add_argument("--root", default=REPO,
+                   help="repo root to scan (default: this checkout)")
+    p.add_argument("--only", default=None,
+                   help="comma-separated checker families "
+                        "(default: all)")
+    p.add_argument("--json", action="store_true",
+                   help="print the one-line bench record (default: "
+                        "human-readable findings + summary)")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="also write the full raftlint JSON report to "
+                        "PATH (the check_regression.py --lint-report "
+                        "input)")
+    p.add_argument("--fix", action="store_true",
+                   help="before linting, append placeholder doc rows "
+                        "for undocumented telemetry emissions "
+                        "(TEL301/TEL303) to docs/OBSERVABILITY.md — "
+                        "the only mechanical fix; everything else "
+                        "needs a human")
+    return p.parse_args(argv)
+
+
+def _apply_doc_fix(ws: Workspace) -> int:
+    """Telemetry doc-sync: append placeholder rows for undocumented
+    emissions.  Returns the number of rows added (0 = doc in sync)."""
+    findings = _telemetry.check(ws)
+    todo = [f for f in findings if f.rule in ("TEL301", "TEL303")]
+    if not todo:
+        return 0
+    new_text, n_rows = _telemetry.fix_documentation(ws, todo)
+    if n_rows:
+        doc_abs = os.path.join(ws.root, _telemetry.DOC_PATH)
+        with open(doc_abs, "w") as f:
+            f.write(new_text)
+        # The workspace caches parsed files; drop the stale doc entry
+        # so the post-fix lint pass sees the appended rows.
+        ws._cache.pop(_telemetry.DOC_PATH, None)
+    return n_rows
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    ws = Workspace(args.root)
+    families = None
+    if args.only:
+        families = [s.strip() for s in args.only.split(",") if s.strip()]
+
+    fixed_rows = 0
+    if args.fix:
+        fixed_rows = _apply_doc_fix(ws)
+        if fixed_rows and not args.json:
+            print(f"--fix: appended {fixed_rows} placeholder row(s) to "
+                  f"{_telemetry.DOC_PATH} (fill in the meaning/fields "
+                  "columns)", file=sys.stderr)
+
+    findings, rules_run = run_checks(ws, families)
+    baseline = load_baseline(os.path.join(args.root, BASELINE_PATH))
+    active, baselined, suppressed = split_findings(ws, findings,
+                                                   baseline)
+    report = make_report(active, baselined, suppressed,
+                         files_scanned(ws), rules_run)
+
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if args.json:
+        print(json.dumps({
+            "metric": "raftlint_findings",
+            "value": float(len(active)),
+            "unit": "findings",
+            "vs_baseline": 0.0,
+            "config": {
+                "counts_by_rule": report["counts_by_rule"],
+                "files_scanned": report["files_scanned"],
+                "baselined": len(baselined),
+                "suppressed": len(suppressed),
+                "families": sorted(families) if families else "all",
+                "fixed_doc_rows": fixed_rows,
+            },
+        }))
+    else:
+        for f in active:
+            print(f"{f.rule} {f.path}:{f.line}: {f.message}")
+        print(f"raftlint: {len(active)} finding(s), "
+              f"{len(baselined)} baselined, {len(suppressed)} "
+              f"suppressed, {report['files_scanned']} files")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,8 +90,14 @@ def main(argv=None) -> int:
                                 RouterConfig, ServeConfig)
 
     model_cfg = RAFTConfig.small_model()  # fp32: CPU-friendly
-    shape = (36, 52)  # -> bucket (40, 56)
-    model_img = jax.numpy.zeros((1, 40, 56, 3))
+    if args.tiny:
+        shape = (36, 52)      # -> bucket (40, 56): the tier-1 drill
+        n_followups = 2
+    else:
+        shape = (68, 100)     # -> bucket (72, 104): heavier soak
+        n_followups = 6
+    bucket = tuple(-(-s // 8) * 8 for s in shape)
+    model_img = jax.numpy.zeros((1,) + bucket + (3,))
     k = jax.random.PRNGKey(args.seed)
     variables = RAFT(model_cfg).init({"params": k, "dropout": k},
                                      model_img, model_img, iters=1)
@@ -148,10 +154,10 @@ def main(argv=None) -> int:
         # subtrees to reach the stream before reconstructing.
         _wait_for(lambda: span_count("attempt") >= 2, 30,
                   "both attempt spans (incl. the straggler's late one)")
-        # a couple of untraced-path-free normal requests for stats depth
-        for _ in range(2):
+        # a few untraced-path-free normal requests for stats depth
+        for _ in range(n_followups):
             router.infer(frame(), frame(), timeout=60)
-        _wait_for(lambda: span_count("route") >= 3, 30,
+        _wait_for(lambda: span_count("route") >= 1 + n_followups, 30,
                   "the follow-up request roots")
         sink.flush()
 

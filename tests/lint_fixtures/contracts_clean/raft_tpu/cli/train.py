@@ -1,0 +1,15 @@
+"""Clean twin CLI: every flag is read."""
+
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--lr", type=float, default=4e-4)
+    p.add_argument("--iters", type=int, default=12)
+    args = p.parse_args(argv)
+    return train(lr=args.lr, iters=args.iters)
+
+
+def train(lr, iters):
+    return lr, iters

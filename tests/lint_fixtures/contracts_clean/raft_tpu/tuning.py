@@ -1,0 +1,5 @@
+"""Clean twin tuning registry: every knob is a config field."""
+
+TUNABLE_KNOBS = ("hidden_dim", "iters")
+
+SERVE_TUNABLE_KNOBS = ("max_batch",)

@@ -1,0 +1,15 @@
+"""Fixture CLI: one flag parses but is never read (CFG401)."""
+
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--lr", type=float, default=4e-4)
+    p.add_argument("--dead-flag", type=int, default=0)   # CFG401 (l. 9)
+    args = p.parse_args(argv)
+    return train(lr=args.lr)
+
+
+def train(lr):
+    return lr

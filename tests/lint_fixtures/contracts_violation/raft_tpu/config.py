@@ -1,0 +1,14 @@
+"""Fixture config dataclasses."""
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class RAFTConfig:
+    hidden_dim: int = 128
+    iters: int = 12
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    lr: float = 4e-4

@@ -1,0 +1,8 @@
+"""Fixture serve config."""
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    max_batch: int = 8

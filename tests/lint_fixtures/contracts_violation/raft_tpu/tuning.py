@@ -1,0 +1,8 @@
+"""Fixture tuning registry: one knob has no backing config field."""
+
+TUNABLE_KNOBS = (
+    "hidden_dim",
+    "ghost_knob",       # CFG403: not a RAFTConfig field (line 5)
+)
+
+SERVE_TUNABLE_KNOBS = ("max_batch",)

@@ -1,0 +1,19 @@
+"""Clean twin: same code shape, no host impurity."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, *, iters: int = 4):
+    if x.ndim == 3:             # static-metadata branch: legal
+        x = x[None]
+    for _ in range(iters):      # static int loop bound: legal
+        x = x + jnp.tanh(x)
+    return x
+
+
+def flow_or_none(x, flow_init=None):
+    if flow_init is not None:   # Python-object identity: legal
+        x = x + flow_init
+    return step(x)

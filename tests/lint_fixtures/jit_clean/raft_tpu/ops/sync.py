@@ -1,0 +1,5 @@
+"""Clean twin: no sync — scaling stays on device."""
+
+
+def scale(arr, factor: float = 2.0):
+    return arr * factor

@@ -1,0 +1,27 @@
+"""Fixture: host impurity inside jit-traced code (JIT101/102/104)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    t0 = time.perf_counter()    # JIT101: trace-time clock (line 12)
+    noise = np.random.rand()    # JIT101: trace-time randomness
+    v = float(x)                # JIT102: host sync on traced value
+    w = x.item()                # JIT102: device sync mid-trace
+    if x > 0:                   # JIT104: Python branch on traced bool
+        v = v + noise + t0 + w
+    return jnp.tanh(x) + v
+
+
+def _inner(y):
+    print(y)                    # JIT101: reached via jax.jit(_inner)
+    return y * 2
+
+
+def build(x):
+    return jax.jit(_inner)(x)

@@ -1,0 +1,6 @@
+"""Fixture: library code forcing a device sync (JIT103)."""
+
+
+def wait(arr):
+    arr.block_until_ready()     # JIT103 (line 5)
+    return arr

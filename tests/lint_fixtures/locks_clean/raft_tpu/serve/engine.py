@@ -1,0 +1,41 @@
+"""Clean twin: every write under the lock, one global lock order."""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self._pending = 0
+
+    def enqueue(self):
+        with self._lock:
+            self._pending += 1
+
+    def reset(self):
+        with self._lock:
+            self._reset_locked()
+
+    def _reset_locked(self):
+        # Caller holds self._lock (*_locked convention).
+        self._pending = 0
+
+    def fwd(self):
+        with self._lock:
+            with self._aux:     # _lock -> _aux, everywhere
+                pass
+
+    def also_fwd(self):
+        with self._lock:
+            with self._aux:
+                pass
+
+
+class Supervisor:
+    def __init__(self, eng):
+        self.eng = eng
+
+    def poke(self, eng):
+        with eng._lock:
+            eng._pending = 0

@@ -1,0 +1,35 @@
+"""Fixture: guarded-write and lock-order violations (LOCK201/202)."""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self._pending = 0
+
+    def enqueue(self):
+        with self._lock:
+            self._pending += 1
+
+    def reset(self):
+        self._pending = 0       # LOCK201: guarded write, no lock
+
+    def fwd(self):
+        with self._lock:
+            with self._aux:     # order: _lock -> _aux
+                pass
+
+    def rev(self):
+        with self._aux:
+            with self._lock:    # LOCK202: opposing order -> cycle
+                pass
+
+
+class Supervisor:
+    def __init__(self, eng):
+        self.eng = eng
+
+    def poke(self, eng):
+        eng._pending = 0        # LOCK201: cross-object guarded write

@@ -1,0 +1,11 @@
+"""Fixture: inline pragma suppression."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    t0 = time.time()  # raftlint: disable=JIT101
+    return x + t0

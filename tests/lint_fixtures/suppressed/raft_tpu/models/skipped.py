@@ -1,0 +1,11 @@
+# raftlint: skip-file
+"""Fixture: file-level opt-out — nothing here is scanned."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def bad(x):
+    return x + time.time()

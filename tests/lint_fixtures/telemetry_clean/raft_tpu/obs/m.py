@@ -1,0 +1,6 @@
+"""Clean twin: every emission has a catalog row and vice versa."""
+
+
+def record(registry, sink):
+    registry.gauge("raft_documented_gauge").set(1.0)
+    sink.emit("documented_event", step=2)

@@ -1,0 +1,11 @@
+"""Clean twin gate: only reads keys the producer writes."""
+
+
+def check(series):
+    out = []
+    for metric, recs in series.items():
+        newest = recs[-1]
+        cfg = newest.get("config") or {}
+        if cfg.get("produced_key"):
+            out.append(metric)
+    return out

@@ -1,0 +1,9 @@
+"""Clean twin producer."""
+
+import json
+
+
+def emit_record():
+    rec = {"metric": "fixture_metric", "value": 1.0,
+           "config": {"produced_key": True}}
+    print(json.dumps(rec))

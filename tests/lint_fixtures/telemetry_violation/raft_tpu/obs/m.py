@@ -1,0 +1,8 @@
+"""Fixture: emissions drifting from the catalog (TEL301/TEL303)."""
+
+
+def record(registry, sink):
+    registry.counter("raft_undocumented_total").inc()   # TEL301 (l. 5)
+    registry.gauge("raft_documented_gauge").set(1.0)    # documented
+    sink.emit("undocumented_event", step=1)             # TEL303 (l. 7)
+    sink.emit("documented_event", step=2)

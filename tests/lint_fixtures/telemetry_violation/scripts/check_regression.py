@@ -1,0 +1,13 @@
+"""Fixture gate: reads one produced key and one ghost key (TEL305)."""
+
+
+def check(series):
+    out = []
+    for metric, recs in series.items():
+        newest = recs[-1]
+        cfg = newest.get("config") or {}
+        if cfg.get("produced_key"):
+            out.append(metric)
+        if cfg.get("ghost_key"):        # TEL305: nobody writes this
+            out.append(metric)
+    return out

@@ -1,0 +1,9 @@
+"""Fixture producer: the record schema the gate may read from."""
+
+import json
+
+
+def emit_record():
+    rec = {"metric": "fixture_metric", "value": 1.0,
+           "config": {"produced_key": True}}
+    print(json.dumps(rec))
