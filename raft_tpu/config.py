@@ -225,6 +225,25 @@ class RAFTConfig:
     # driver dryrun use this to exercise the shipped kernel path without
     # a TPU).  Inert on TPU.
     pallas_offtpu: str = "fallback"
+    # Fuse the Pallas pyramid lookup with the motion encoder's first
+    # 1x1 corr conv (models/update.py convc1): the sampled taps feed
+    # the conv accumulator in VMEM and the (B,H/8,W/8,levels*(2r+1)^2)
+    # corr-feature tensor never reaches HBM (ops/pallas_corr.py
+    # ``pallas_pyramid_lookup_encode``).  fp32 accumulation; int8/fp8
+    # dequant folds into the conv weights per (batch, level); the
+    # stop-gradient boundary is unchanged (fnet gets zero grad through
+    # the volume, conv weights/bias and the rest of the update block
+    # still learn).  Requires corr_impl='allpairs_pallas'; autotuner-
+    # ranked (scripts/autotune.py), default off so untuned runs are
+    # bit-identical to the unfused path.
+    fused_lookup_encoder: bool = False
+    # Fuse the ConvGRU gate chains (models/update.py ConvGRU/SepConvGRU)
+    # with Pallas elementwise kernels (ops/pallas_gru.py): sigmoid(r)*h
+    # and the (1-sigmoid(z))*h + sigmoid(z)*tanh(q) blend each become
+    # one VMEM pass instead of an XLA elementwise chain with HBM
+    # round-trips; the convs stay XLA (convq's input depends on r).
+    # Grads via recomputing custom_vjp.  Autotuner-ranked; default off.
+    fused_gru: bool = False
 
     @classmethod
     def full(cls, **kw) -> "RAFTConfig":
@@ -289,6 +308,38 @@ class RAFTConfig:
             _warn_pallas_fallback("upsample_loss_kernel='pallas'", "xla")
             return "xla"
         return self.upsample_loss_kernel
+
+    @property
+    def resolved_fused_lookup_encoder(self) -> bool:
+        """``fused_lookup_encoder`` with its preconditions applied.
+
+        True only when the knob is on AND the resolved corr impl is the
+        materialized-pyramid Pallas path ('allpairs_pallas' — the fused
+        kernel samples that pyramid layout) AND Pallas dispatch is
+        available (TPU, or pallas_offtpu='interpret').  Off-TPU with
+        the default fallback this resolves False through
+        ``resolved_corr_impl``'s own substitution, so default configs
+        stay bit-identical to the unfused path.
+        """
+        if not self.fused_lookup_encoder:
+            return False
+        if self.resolved_corr_impl != "allpairs_pallas":
+            _warn_pallas_fallback(
+                "fused_lookup_encoder=True (requires "
+                "corr_impl='allpairs_pallas')", "unfused lookup+conv")
+            return False
+        return True
+
+    @property
+    def resolved_fused_gru(self) -> bool:
+        """``fused_gru`` with the off-TPU Pallas fallback applied."""
+        if not self.fused_gru:
+            return False
+        if not self._pallas_dispatchable():
+            _warn_pallas_fallback("fused_gru=True",
+                                  "unfused XLA gate chain")
+            return False
+        return True
 
     @property
     def resolved_upsample_dtype(self) -> str:

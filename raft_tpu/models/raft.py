@@ -45,8 +45,8 @@ from jax.ad_checkpoint import checkpoint_name
 
 from raft_tpu.config import RAFTConfig
 from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
-from raft_tpu.models.update import (BasicUpdateBlock, MaskHead,
-                                    SmallUpdateBlock)
+from raft_tpu.models.update import (BasicUpdateBlock, FusedCorrLookup,
+                                    MaskHead, SmallUpdateBlock)
 from raft_tpu.ops.corr import (
     QuantizedLevel,
     build_corr_pyramid,
@@ -110,7 +110,21 @@ class RefinementStep(nn.Module):
         coords1 = jax.lax.stop_gradient(coords1)
 
         corr_impl = cfg.resolved_corr_impl
-        if corr_impl == "allpairs":
+        if cfg.resolved_fused_lookup_encoder:
+            # Defer the lookup INTO the motion encoder: the fused Pallas
+            # kernel (ops/pallas_corr.pallas_pyramid_lookup_encode)
+            # samples the pyramid and applies convc1 in one VMEM pass —
+            # the (B, H/8, W/8, corr_planes) tap tensor never reaches
+            # HBM.  coords1 is already detached above, and the fused
+            # kernel's vjp preserves the unfused gradient semantics
+            # (real dcorr for fp32/bf16 pyramids, the stop-gradient
+            # zeros for quantized ones).  The 'corr' remat tag moves to
+            # the fused conv output inside the encoder.
+            corr = FusedCorrLookup(
+                pyramid=corr_state, coords=coords1,
+                channels=cfg.corr_planes, radius=cfg.corr_radius,
+                block_q=cfg.lookup_block_q)
+        elif corr_impl == "allpairs":
             corr = corr_lookup(corr_state, coords1, cfg.corr_radius,
                                cfg.resolved_corr_precision)
         elif corr_impl == "chunked":
@@ -154,14 +168,22 @@ class RefinementStep(nn.Module):
         # Tag the sampled window features so remat_policy='save_corr' can
         # keep them (and only them) for the backward pass: the window
         # sampling is ~half the forward iteration, and its taps are small
-        # (B, H/8, W/8, levels*(2r+1)^2).
-        corr = checkpoint_name(corr.astype(dt), "corr")
+        # (B, H/8, W/8, levels*(2r+1)^2).  (On the fused-lookup path the
+        # taps never materialize; the encoder tags the fused conv output
+        # instead.)
+        if not isinstance(corr, FusedCorrLookup):
+            corr = checkpoint_name(corr.astype(dt), "corr")
 
         flow = coords1 - coords0
+        fused_gru = cfg.resolved_fused_gru
         if cfg.small:
-            block = SmallUpdateBlock(cfg.hidden_dim, dt, name="update_block")
+            block = SmallUpdateBlock(cfg.hidden_dim, dt,
+                                     fused_gru=fused_gru,
+                                     name="update_block")
         else:
-            block = BasicUpdateBlock(cfg.hidden_dim, dt, name="update_block")
+            block = BasicUpdateBlock(cfg.hidden_dim, dt,
+                                     fused_gru=fused_gru,
+                                     name="update_block")
         net, delta_flow = block(net, inp, corr, flow.astype(dt))
 
         coords1 = coords1 + delta_flow.astype(jnp.float32)

@@ -19,6 +19,7 @@ Parity notes:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
 
 import flax.linen as nn
@@ -26,7 +27,8 @@ import jax.numpy as jnp
 
 from jax.ad_checkpoint import checkpoint_name
 
-from raft_tpu.models.layers import conv
+from raft_tpu.models.layers import (_torch_default_uniform, conv,
+                                    torch_bias_init)
 
 
 def _tconv(features, kernel, cin, dtype, name):
@@ -34,6 +36,64 @@ def _tconv(features, kernel, cin, dtype, name):
     to the encoders; update-block convs keep torch defaults)."""
     return conv(features, kernel, 1, dtype, name=name,
                 torch_default_init=True, in_features=cin)
+
+
+@dataclasses.dataclass
+class FusedCorrLookup:
+    """Deferred correlation lookup (``fused_lookup_encoder`` path).
+
+    When ``RAFTConfig.resolved_fused_lookup_encoder`` is on, the
+    refinement step hands the motion encoder THIS instead of the
+    materialized ``(B, H/8, W/8, levels*(2r+1)^2)`` corr-feature tensor;
+    the encoder then runs ``ops/pallas_corr.pallas_pyramid_lookup_encode``,
+    which samples the pyramid AND applies convc1 (+bias+relu) in one
+    Pallas kernel — the tap tensor never reaches HBM.  Plain Python
+    container (not a pytree): it is built and consumed inside one scan
+    body trace, never crossing a jit/scan boundary itself.
+    """
+
+    pyramid: Any        # list of per-level arrays or QuantizedLevel
+    coords: Any         # (B, H/8, W/8, 2) fp32 lookup centers
+    channels: int       # levels * (2r+1)^2 == convc1 fan-in
+    radius: int
+    block_q: int
+    interpret: Any = None   # None = auto (TPU native / CPU interpreter)
+
+
+class _Conv1x1Params(nn.Module):
+    """Declare a 1x1 conv's parameters without applying it.
+
+    Instantiated under the SAME scope name ("convc1") and with the same
+    init/shape/dtype conventions as ``_tconv``'s ``nn.Conv``, so the
+    param tree — and any torch-converted checkpoint — is interchangeable
+    between the fused and unfused motion-encoder paths.
+    """
+
+    features: int
+    cin: int
+
+    @nn.compact
+    def __call__(self):
+        kernel = self.param("kernel", _torch_default_uniform,
+                            (1, 1, self.cin, self.features))
+        bias = self.param("bias", torch_bias_init(self.cin),
+                          (self.features,))
+        return kernel, bias
+
+
+def _fused_corr_encode(fused: "FusedCorrLookup", kernel, bias, features,
+                       dtype):
+    """convc1(lookup(pyramid)) + relu via the fused Pallas kernel."""
+    from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup_encode
+
+    cor = pallas_pyramid_lookup_encode(
+        fused.pyramid, fused.coords,
+        kernel.reshape(fused.channels, features), bias,
+        fused.radius, fused.block_q, fused.interpret, jnp.dtype(dtype))
+    # Same remat tag the unfused path puts on the sampled taps
+    # (remat_policy='save_corr'): saving the fused conv output skips
+    # both the re-lookup and the conv in the backward recompute.
+    return checkpoint_name(cor, "corr")
 
 
 class FlowHead(nn.Module):
@@ -56,13 +116,23 @@ class ConvGRU(nn.Module):
 
     hidden_dim: int = 128
     dtype: Any = jnp.float32
+    fused: bool = False
 
     @nn.compact
     def __call__(self, h, x):
         hx = jnp.concatenate([h, x], axis=-1)
         cin = hx.shape[-1]
-        zr = nn.sigmoid(_tconv(2 * self.hidden_dim, 3, cin, self.dtype,
-                               "convzr")(hx))
+        zr_raw = _tconv(2 * self.hidden_dim, 3, cin, self.dtype,
+                        "convzr")(hx)
+        if self.fused:
+            from raft_tpu.ops.pallas_gru import (gru_gate_blend,
+                                                 gru_gate_rh)
+
+            z_raw, r_raw = jnp.split(zr_raw, 2, axis=-1)
+            q_raw = _tconv(self.hidden_dim, 3, cin, self.dtype, "convq")(
+                jnp.concatenate([gru_gate_rh(r_raw, h), x], axis=-1))
+            return gru_gate_blend(z_raw, q_raw, h)
+        zr = nn.sigmoid(zr_raw)
         z, r = jnp.split(zr, 2, axis=-1)
         q = jnp.tanh(_tconv(self.hidden_dim, 3, cin, self.dtype, "convq")(
             jnp.concatenate([r * h, x], axis=-1)))
@@ -75,28 +145,32 @@ class SepConvGRU(nn.Module):
 
     hidden_dim: int = 128
     dtype: Any = jnp.float32
+    fused: bool = False
+
+    def _pass(self, h, x, cin, ksize, zr_name, q_name):
+        dt = self.dtype
+        hx = jnp.concatenate([h, x], axis=-1)
+        zr_raw = _tconv(2 * self.hidden_dim, ksize, cin, dt, zr_name)(hx)
+        if self.fused:
+            from raft_tpu.ops.pallas_gru import (gru_gate_blend,
+                                                 gru_gate_rh)
+
+            z_raw, r_raw = jnp.split(zr_raw, 2, axis=-1)
+            q_raw = _tconv(self.hidden_dim, ksize, cin, dt, q_name)(
+                jnp.concatenate([gru_gate_rh(r_raw, h), x], axis=-1))
+            return gru_gate_blend(z_raw, q_raw, h)
+        zr = nn.sigmoid(zr_raw)
+        z, r = jnp.split(zr, 2, axis=-1)
+        q = jnp.tanh(_tconv(self.hidden_dim, ksize, cin, dt, q_name)(
+            jnp.concatenate([r * h, x], axis=-1)))
+        return (1 - z) * h + z * q
 
     @nn.compact
     def __call__(self, h, x):
-        dt = self.dtype
-        # horizontal pass (1x5 kernels)
-        hx = jnp.concatenate([h, x], axis=-1)
-        cin = hx.shape[-1]
-        zr = nn.sigmoid(_tconv(2 * self.hidden_dim, (1, 5), cin, dt,
-                               "convzr1")(hx))
-        z, r = jnp.split(zr, 2, axis=-1)
-        q = jnp.tanh(_tconv(self.hidden_dim, (1, 5), cin, dt, "convq1")(
-            jnp.concatenate([r * h, x], axis=-1)))
-        h = (1 - z) * h + z * q
-
-        # vertical pass (5x1 kernels)
-        hx = jnp.concatenate([h, x], axis=-1)
-        zr = nn.sigmoid(_tconv(2 * self.hidden_dim, (5, 1), cin, dt,
-                               "convzr2")(hx))
-        z, r = jnp.split(zr, 2, axis=-1)
-        q = jnp.tanh(_tconv(self.hidden_dim, (5, 1), cin, dt, "convq2")(
-            jnp.concatenate([r * h, x], axis=-1)))
-        return (1 - z) * h + z * q
+        cin = h.shape[-1] + x.shape[-1]
+        # horizontal (1x5) then vertical (5x1) pass
+        h = self._pass(h, x, cin, (1, 5), "convzr1", "convq1")
+        return self._pass(h, x, cin, (5, 1), "convzr2", "convq2")
 
 
 class SmallMotionEncoder(nn.Module):
@@ -105,7 +179,13 @@ class SmallMotionEncoder(nn.Module):
     @nn.compact
     def __call__(self, flow, corr):
         dt = self.dtype
-        cor = nn.relu(_tconv(96, 1, corr.shape[-1], dt, "convc1")(corr))
+        if isinstance(corr, FusedCorrLookup):
+            kernel, bias = _Conv1x1Params(96, corr.channels,
+                                          name="convc1")()
+            cor = _fused_corr_encode(corr, kernel, bias, 96, dt)
+        else:
+            cor = nn.relu(
+                _tconv(96, 1, corr.shape[-1], dt, "convc1")(corr))
         flo = nn.relu(_tconv(64, 7, 2, dt, "convf1")(flow))
         flo = nn.relu(_tconv(32, 3, 64, dt, "convf2")(flo))
         out = nn.relu(_tconv(80, 3, 128, dt, "conv")(
@@ -123,7 +203,13 @@ class BasicMotionEncoder(nn.Module):
     @nn.compact
     def __call__(self, flow, corr):
         dt = self.dtype
-        cor = nn.relu(_tconv(256, 1, corr.shape[-1], dt, "convc1")(corr))
+        if isinstance(corr, FusedCorrLookup):
+            kernel, bias = _Conv1x1Params(256, corr.channels,
+                                          name="convc1")()
+            cor = _fused_corr_encode(corr, kernel, bias, 256, dt)
+        else:
+            cor = nn.relu(
+                _tconv(256, 1, corr.shape[-1], dt, "convc1")(corr))
         cor = nn.relu(_tconv(192, 3, 256, dt, "convc2")(cor))
         flo = nn.relu(_tconv(128, 7, 2, dt, "convf1")(flow))
         flo = nn.relu(_tconv(64, 3, 128, dt, "convf2")(flo))
@@ -139,12 +225,14 @@ class BasicMotionEncoder(nn.Module):
 class SmallUpdateBlock(nn.Module):
     hidden_dim: int = 96
     dtype: Any = jnp.float32
+    fused_gru: bool = False
 
     @nn.compact
     def __call__(self, net, inp, corr, flow):
         motion = SmallMotionEncoder(self.dtype, name="encoder")(flow, corr)
         x = jnp.concatenate([inp, motion], axis=-1)
-        net = ConvGRU(self.hidden_dim, self.dtype, name="gru")(net, x)
+        net = ConvGRU(self.hidden_dim, self.dtype, fused=self.fused_gru,
+                      name="gru")(net, x)
         delta_flow = FlowHead(128, self.dtype, name="flow_head")(net)
         return net, delta_flow
 
@@ -159,12 +247,14 @@ class BasicUpdateBlock(nn.Module):
 
     hidden_dim: int = 128
     dtype: Any = jnp.float32
+    fused_gru: bool = False
 
     @nn.compact
     def __call__(self, net, inp, corr, flow):
         motion = BasicMotionEncoder(self.dtype, name="encoder")(flow, corr)
         x = jnp.concatenate([inp, motion], axis=-1)
-        net = SepConvGRU(self.hidden_dim, self.dtype, name="gru")(net, x)
+        net = SepConvGRU(self.hidden_dim, self.dtype,
+                         fused=self.fused_gru, name="gru")(net, x)
         delta_flow = FlowHead(256, self.dtype, name="flow_head")(net)
         return net, delta_flow
 

@@ -90,11 +90,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x;
-# accept either so the kernels (and their interpret-mode tests) run on
-# both sides of the rename.
-_CompilerParams = getattr(pltpu, "CompilerParams", None) \
-    or pltpu.TPUCompilerParams
+from raft_tpu.ops.pallas_util import tpu_pallas_call
 
 
 # Image rows per inner mat-mul tile; statically unrolled inside, fori_loop
@@ -449,13 +445,11 @@ def _odm_bwd_blocked_level(lvl, f2, f1p, cpt, gp, k, inv_scale, block_q,
         # Zero rows contribute zero to df1 regardless of tap weights, and
         # the padded df2 rows are sliced away below — no in-kernel masks.
         f2p = jnp.pad(f2p, ((0, 0), (0, Hp - Hl), (0, 0), (0, 0)))
-    vmem = _CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
-
     lkk = gp.shape[1]
     kern1 = functools.partial(_odm_bwd_df1_blocked_kernel, lvl=lvl,
                               g_off=lvl * k * k, wl=Wl, k=k,
                               inv_scale=inv_scale, tile_h=tile_h)
-    df1 = pl.pallas_call(
+    df1 = tpu_pallas_call(
         kern1,
         grid=(B, QB, TY),
         in_specs=[
@@ -469,14 +463,13 @@ def _odm_bwd_blocked_level(lvl, f2, f1p, cpt, gp, k, inv_scale, block_q,
         out_specs=pl.BlockSpec((1, block_q, C), lambda b, q, t: (b, q, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, Npad, C), jnp.float32),
-        compiler_params=vmem,
         interpret=interpret,
     )(f2p, cpt, gp)
 
     kern2 = functools.partial(_odm_bwd_df2_blocked_kernel, lvl=lvl,
                               g_off=lvl * k * k, wl=Wl, k=k,
                               inv_scale=inv_scale, tile_h=tile_h)
-    df2p = pl.pallas_call(
+    df2p = tpu_pallas_call(
         kern2,
         grid=(B, TY, QB),
         in_specs=[
@@ -491,7 +484,6 @@ def _odm_bwd_blocked_level(lvl, f2, f1p, cpt, gp, k, inv_scale, block_q,
                                lambda b, t, q: (b, t, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, Hp, Wl, C), jnp.float32),
-        compiler_params=vmem,
         interpret=interpret,
     )(f1p, cpt, gp)
     return df1, df2p[:, :Hl]
@@ -717,7 +709,7 @@ def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret,
         for _, c in nonempty
     ] + [pl.BlockSpec((1, 2, block_q), lambda b, i: (b, 0, i),
                       memory_space=pltpu.VMEM)]
-    return pl.pallas_call(
+    return tpu_pallas_call(
         kern,
         grid=(B, Npad // block_q),
         in_specs=in_specs,
@@ -729,8 +721,6 @@ def _pyr_levels_fwd(pyramid, coords_p, radius, block_q, interpret,
             pltpu.VMEM((k * c.shape[2], block_q), jnp.float32)
             for _, c in nonempty
         ],
-        compiler_params=_CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(*[c for _, c in nonempty], coords_p)
 
@@ -761,7 +751,7 @@ def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
             _pyr_multi_bwd_kernel,
             levels=[(lvl, lvl * k * k, s[1], s[2]) for lvl, s, _ in grp],
             k=k)
-        outs = pl.pallas_call(
+        outs = tpu_pallas_call(
             kern,
             grid=(B, Npad // block_q),
             in_specs=[
@@ -781,8 +771,6 @@ def _pyr_levels_bwd(coords_p, g, shapes, radius, block_q, interpret):
                 jax.ShapeDtypeStruct((B, s[1], s[2], Npad), dt)
                 for _, s, dt in grp
             ],
-            compiler_params=_CompilerParams(
-                vmem_limit_bytes=100 * 1024 * 1024),
             interpret=interpret,
         )(coords_p, g)
         for (lvl, _, _), out in zip(grp, outs):
@@ -1017,7 +1005,7 @@ def _corr_fwd(fmap1, fmap2_pyramid, coords, radius, block_q, interpret):
         pl.BlockSpec((1, 2, block_q), lambda b, i: (b, 0, i),
                      memory_space=pltpu.VMEM),
     ]
-    out = pl.pallas_call(
+    out = tpu_pallas_call(
         kern,
         grid=(B, Npad // block_q),
         in_specs=in_specs,
@@ -1029,8 +1017,6 @@ def _corr_fwd(fmap1, fmap2_pyramid, coords, radius, block_q, interpret):
             pltpu.VMEM((f2.shape[1] * f2.shape[2], block_q), jnp.float32)
             for _, f2 in nonempty
         ],
-        compiler_params=_CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(*[f2.astype(f2dt) for _, f2 in nonempty], f1p,
       cp.transpose(0, 2, 1))
@@ -1092,7 +1078,7 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
             jax.ShapeDtypeStruct((B, f2.shape[1], f2.shape[2], C),
                                  jnp.float32)
             for _, f2 in fused)
-        outs = pl.pallas_call(
+        outs = tpu_pallas_call(
             kern,
             grid=(B, Npad // block_q),
             in_specs=in_specs,
@@ -1103,8 +1089,6 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
                            jnp.float32)
                 for _, f2 in fused
             ],
-            compiler_params=_CompilerParams(
-                vmem_limit_bytes=100 * 1024 * 1024),
             interpret=interpret,
         )(*[f2.astype(f2dt) for _, f2 in fused], f1p,
           cp.transpose(0, 2, 1), gp)
@@ -1152,3 +1136,238 @@ def _corr_bwd(radius, block_q, interpret, residuals, g):
 
 
 pallas_corr_lookup.defvjp(_corr_fwd, _corr_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused lookup -> motion-encoder convc1 (fused_lookup_encoder)
+#
+# The pyramid lookup's ONLY consumer is the motion encoder's first conv
+# (models/update.py convc1) — a 1x1 conv over the (2r+1)^2*levels tap
+# channels.  Unfused, the (B, H/8, W/8, 324) tap tensor round-trips HBM
+# between the lookup kernel and the conv.  Here the tap block stays in
+# the VMEM scratch the lookup already accumulates into and feeds one MXU
+# contraction (taps^T @ W) + bias + relu in the same kernel instance:
+# the tap tensor never materializes.
+#
+# Quantized pyramids ride for free: sampling is linear in the stored
+# codes and the conv is linear in the taps, so the per-(batch, level)
+# dequant scale FOLDS INTO THE CONV WEIGHTS (w_l <- scale_bl * w_l) —
+# the kernel contracts raw-code taps against pre-scaled weights, fp32
+# accumulation end to end, and dequant-on-tap semantics are preserved
+# exactly.  Backward is a recomputing custom_vjp: relu-masked cotangent
+# -> dW/db by re-running the (unfused) lookup, pyramid/coords
+# cotangents via the unfused lookup's own vjp (real dcorr for fp32/bf16
+# pyramids, structural zeros for quantized ones — the same stop-gradient
+# boundary, so fnet still gets zero grad through a quantized volume).
+# ---------------------------------------------------------------------------
+
+
+def _pyr_encode_kernel(*refs, levels, k, kk_pad):
+    """Fused taps -> 1x1 conv (+bias+relu) kernel body.
+
+    refs = [corr_0..corr_{n-1}, c, w, bias, out, taps, acc_0..acc_{n-1}];
+    ``w`` is (1, kk_pad, Fpad) fp32 with any dequant scale pre-folded,
+    ``taps`` a (1, kk_pad, BQ) fp32 VMEM scratch standing in for the
+    unfused kernel's HBM tap output.  The mat-mul runs once per grid
+    instance, OUTSIDE the row-tile loops (in-loop mat-muls regress to
+    scalar code — see the Mosaic lessons at the top of this file).
+    """
+    nl = len(levels)
+    c_ref = refs[nl]
+    w_ref = refs[nl + 1]
+    b_ref = refs[nl + 2]
+    out_ref = refs[nl + 3]
+    taps_ref = refs[nl + 4]
+    acc_refs = refs[nl + 5:]
+    bq = c_ref.shape[2]
+    covered = 0
+    for (lvl, off, hl, wl), corr_ref, acc_ref in zip(levels, refs[:nl],
+                                                     acc_refs):
+        _pyr_fwd_level_body(corr_ref, c_ref, taps_ref, acc_ref, lvl, off,
+                            hl, wl, k)
+        covered += k * k
+    if covered < kk_pad:  # empty trailing levels + sublane-pad rows
+        taps_ref[0, covered:, :] = jnp.zeros((kk_pad - covered, bq),
+                                             taps_ref.dtype)
+    taps = taps_ref[0]                                 # (kk_pad, BQ)
+    out = jax.lax.dot_general(
+        taps, w_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (BQ, Fpad)
+    out = jnp.maximum(out + b_ref[...], 0.0)
+    out_ref[0, :, :] = out.astype(out_ref.dtype)
+
+
+def _pyr_levels_fwd_encode(values, coords_p, w_scaled, bias, radius,
+                           block_q, interpret):
+    """One pallas_call: lookup + convc1 -> (B, Npad, Fpad) fp32."""
+    B = values[0].shape[0]
+    Npad = values[0].shape[3]
+    k = 2 * radius + 1
+    nonempty, levels = _odm_levels(values, k)
+    kk_pad, fpad = w_scaled.shape[1], w_scaled.shape[2]
+    kern = functools.partial(_pyr_encode_kernel, levels=levels, k=k,
+                             kk_pad=kk_pad)
+    in_specs = [
+        pl.BlockSpec((1, c.shape[1], c.shape[2], block_q),
+                     lambda b, i: (b, 0, 0, i),
+                     memory_space=pltpu.VMEM)
+        for _, c in nonempty
+    ] + [
+        pl.BlockSpec((1, 2, block_q), lambda b, i: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, kk_pad, fpad), lambda b, i: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, fpad), lambda b, i: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    return tpu_pallas_call(
+        kern,
+        grid=(B, Npad // block_q),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, fpad),
+                               lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, Npad, fpad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, kk_pad, block_q), jnp.float32)] + [
+            pltpu.VMEM((k * c.shape[2], block_q), jnp.float32)
+            for _, c in nonempty
+        ],
+        interpret=interpret,
+    )(*[c for _, c in nonempty], coords_p, w_scaled, bias)
+
+
+def _is_quantized_pyramid(pyramid) -> bool:
+    # Duck-typed (QuantizedLevel carries .values/.scale) so this module
+    # needs no import from ops.corr.
+    return hasattr(pyramid[0], "values")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def pallas_pyramid_lookup_encode(pyramid, coords, weight, bias,
+                                 radius: int = 4, block_q: int = 128,
+                                 interpret=None, out_dtype=jnp.float32):
+    """Fused pyramid lookup + motion-encoder convc1 (+bias+relu).
+
+    Equivalent to::
+
+        corr = pallas_pyramid_lookup[_quantized](pyramid, coords, ...)
+        out  = relu(corr @ weight + bias)       # the 1x1 convc1
+
+    but the ``(B, H1, W1, L*(2r+1)^2)`` tap tensor never reaches HBM:
+    taps accumulate in the lookup's VMEM scratch and feed the conv
+    contraction in the same kernel instance (fp32 accumulation both
+    stages).  Accepts plain (fp32/bf16) OR quantized
+    (:class:`~raft_tpu.ops.corr.QuantizedLevel`) pyramids — for the
+    latter the per-(batch, level) dequant scale is folded into
+    ``weight`` before the kernel, preserving dequant-on-tap semantics
+    exactly.
+
+    Args:
+      pyramid: query-minor levels from ``build_corr_pyramid_flat``
+        (arrays or QuantizedLevel; Npad a multiple of ``block_q``).
+      coords: ``(B, H1, W1, 2)`` level-0 centroids (detached inside —
+        coords cotangent is structurally zero, matching the unfused
+        refinement-step contract).
+      weight: ``(L*(2r+1)^2, F)`` convc1 kernel (the HWIO ``(1,1,KK,F)``
+        conv param reshaped).
+      bias: ``(F,)`` convc1 bias.
+
+    Returns ``(B, H1, W1, F)`` ``out_dtype`` activations.
+
+    Gradients: ``weight``/``bias`` always; pyramid cotangents are real
+    for fp32/bf16 storage (delegated to the unfused lookup's vjp) and
+    structural zeros for quantized storage (the stop-gradient boundary
+    — fnet gets zero grad through a quantized volume, unchanged).
+    """
+    out, _ = _pyr_enc_fwd(pyramid, coords, weight, bias, radius, block_q,
+                          interpret, out_dtype)
+    return out
+
+
+def _pyr_enc_fwd(pyramid, coords, weight, bias, radius, block_q,
+                 interpret, out_dtype):
+    if interpret is None:
+        interpret = _auto_interpret()
+    quantized = _is_quantized_pyramid(pyramid)
+    values = [lv.values if quantized else lv for lv in pyramid]
+    B, H1, W1, _ = coords.shape
+    N = H1 * W1
+    Npad = values[0].shape[3]
+    if Npad % block_q:
+        raise ValueError(
+            f"pyramid query dim {Npad} is not a multiple of block_q "
+            f"{block_q}; build the pyramid with "
+            f"build_corr_pyramid_flat(..., pad_q={block_q})")
+    k = 2 * radius + 1
+    L = len(values)
+    kk = L * k * k
+    if weight.shape != (kk, weight.shape[1]) or weight.shape[0] != kk:
+        raise ValueError(
+            f"weight shape {weight.shape} does not match the tap count "
+            f"levels*(2r+1)^2 = {kk}")
+    F = weight.shape[1]
+    kk_pad = -(-kk // 8) * 8          # sublane-tile align the contraction
+    fpad = -(-F // 128) * 128         # lane-tile align the conv features
+    c = _pad_coords_oor(
+        jax.lax.stop_gradient(coords).reshape(B, N, 2).astype(jnp.float32),
+        Npad).transpose(0, 2, 1)
+    w32 = weight.astype(jnp.float32)
+    if quantized:
+        scale = jnp.concatenate(
+            [lv.scale.reshape(B, 1) for lv in pyramid], axis=1)  # (B, L)
+        wb = w32.reshape(L, k * k, F)[None] * scale[:, :, None, None]
+        wb = wb.reshape(B, kk, F)
+    else:
+        wb = jnp.broadcast_to(w32[None], (B, kk, F))
+    wb = jnp.pad(wb, ((0, 0), (0, kk_pad - kk), (0, fpad - F)))
+    b2 = jnp.pad(bias.astype(jnp.float32).reshape(1, F),
+                 ((0, 0), (0, fpad - F)))
+    out = _pyr_levels_fwd_encode(values, c, wb, b2, radius, block_q,
+                                 interpret)
+    out = out[:, :N, :F].reshape(B, H1, W1, F).astype(out_dtype)
+    return out, (pyramid, coords, weight, bias, out)
+
+
+def _pyr_enc_bwd(radius, block_q, interpret, out_dtype, residuals, g):
+    pyramid, coords, weight, bias, out = residuals
+    if interpret is None:
+        interpret = _auto_interpret()
+    quantized = _is_quantized_pyramid(pyramid)
+    # relu mask from the saved activations; fp32 math below.
+    gm = (g * (out > 0)).astype(jnp.float32)           # (B, H1, W1, F)
+    if quantized:
+        # Recompute the DEQUANTIZED taps (dW is w.r.t. the scaled
+        # contraction the forward ran); codes/scales get structural
+        # zeros — int codes have no tangent space (float0), and the
+        # scale sits behind the same stop-gradient as the codes.
+        corr = pallas_pyramid_lookup_quantized(
+            pyramid, coords, radius, block_q, interpret, jnp.float32)
+
+        def _zero_ct(x):
+            if jnp.issubdtype(x.dtype, jnp.inexact):
+                return jnp.zeros_like(x)
+            import numpy as np
+
+            return np.zeros(x.shape, jax.dtypes.float0)
+
+        dpyr = jax.tree_util.tree_map(_zero_ct, pyramid)
+        dcoords = jnp.zeros_like(coords)
+    else:
+        # Delegate to the unfused lookup's own vjp: identical recompute
+        # + transpose kernels, so the fused path inherits the exact
+        # unfused gradient semantics (real per-level dcorr, zero
+        # dcoords).
+        def lookup(p, c):
+            return pallas_pyramid_lookup(p, c, radius, block_q,
+                                         interpret, jnp.float32)
+
+        corr, pullback = jax.vjp(lookup, pyramid, coords)
+        g_corr = jnp.einsum("bhwf,kf->bhwk", gm,
+                            weight.astype(jnp.float32))
+        dpyr, dcoords = pullback(g_corr)
+    dw = jnp.einsum("bhwk,bhwf->kf", corr.astype(jnp.float32), gm)
+    db = jnp.sum(gm, axis=(0, 1, 2))
+    return dpyr, dcoords, dw.astype(weight.dtype), db.astype(bias.dtype)
+
+
+pallas_pyramid_lookup_encode.defvjp(_pyr_enc_fwd, _pyr_enc_bwd)

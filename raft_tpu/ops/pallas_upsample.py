@@ -61,6 +61,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.ops.pallas_util import auto_interpret, tpu_pallas_call
+
 
 def _softmax_parts(m):
     """Per-tap-group softmax pieces from (H, W, 576) logits: list of 9
@@ -193,14 +195,12 @@ def _core_fwd(fb, mask, gt128, vm64, interpret):
     H, W = Hp2 - 2, Wp2 - 2
     B = gt128.shape[0]
     s = _specs(gB, B, H, W)
-    out = pl.pallas_call(
+    out = tpu_pallas_call(
         functools.partial(_upsample_loss_fwd_kernel, H=H, W=W),
         grid=(gB,),
         in_specs=[s["fb"], s["mask"], s["gt"], s["vm"]],
         out_specs=s["sums"],
         out_shape=jax.ShapeDtypeStruct((gB, 8, 128), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(fb, mask, gt128, vm64)
     return out, (fb, mask, gt128, vm64)
@@ -212,7 +212,7 @@ def _core_bwd(interpret, residuals, g):
     H, W = Hp2 - 2, Wp2 - 2
     B = gt128.shape[0]
     s = _specs(gB, B, H, W)
-    dmask, dfb = pl.pallas_call(
+    dmask, dfb = tpu_pallas_call(
         functools.partial(_upsample_loss_bwd_kernel, H=H, W=W),
         grid=(gB,),
         in_specs=[s["fb"], s["mask"], s["gt"], s["vm"], s["sums"]],
@@ -222,8 +222,6 @@ def _core_bwd(interpret, residuals, g):
             jax.ShapeDtypeStruct(fb.shape, fb.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((H + 2, W + 2, 128), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(fb, mask, gt128, vm64, g.astype(jnp.float32))
     return dfb, dmask, jnp.zeros_like(gt128), jnp.zeros_like(vm64)
@@ -233,8 +231,6 @@ _upsample_loss_core.defvjp(_core_fwd, _core_bwd)
 
 
 def _auto_interpret() -> bool:
-    from raft_tpu.ops.pallas_util import auto_interpret
-
     return auto_interpret()
 
 

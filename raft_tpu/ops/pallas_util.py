@@ -1,10 +1,37 @@
-"""Shared Pallas dispatch helpers."""
+"""Shared Pallas dispatch helpers.
+
+One home for the two decisions every kernel in this repo used to make
+for itself (copy-pasted between ``ops/pallas_corr.py`` and
+``ops/pallas_upsample.py`` until PR 13):
+
+- **interpret-mode selection** (:func:`auto_interpret`): Pallas kernels
+  run natively only on TPU; on the CPU test backend they run in the
+  interpreter, and on other accelerators they warn.
+- **compiler-params construction** (:func:`compiler_params` /
+  :func:`tpu_pallas_call`): the ``TPUCompilerParams`` →
+  ``CompilerParams`` rename shim and the repo-wide 100 MB
+  ``vmem_limit_bytes`` default live here, so a jax upgrade or a VMEM
+  budget change is one edit, not four.
+"""
 
 from __future__ import annotations
 
 import warnings
 
 import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x;
+# accept either so the kernels (and their interpret-mode tests) run on
+# both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
+# Every kernel in the repo declares the same VMEM budget: large enough
+# for the beyond-HBM correlation levels, small enough that Mosaic still
+# double-buffers block DMA (see pallas_corr.py "VMEM sizing").
+_DEFAULT_VMEM_LIMIT_MB = 100
 
 _warned_interpret = False
 
@@ -30,3 +57,27 @@ def auto_interpret() -> bool:
                 "slow) Pallas interpreter; prefer the XLA implementations "
                 "on this backend", stacklevel=3)
     return True
+
+
+def compiler_params(vmem_limit_mb: int = _DEFAULT_VMEM_LIMIT_MB):
+    """The repo-standard TPU compiler params (rename-shimmed)."""
+    return _CompilerParams(vmem_limit_bytes=vmem_limit_mb * 1024 * 1024)
+
+
+def tpu_pallas_call(kernel, *, interpret=None,
+                    vmem_limit_mb: int = _DEFAULT_VMEM_LIMIT_MB, **kw):
+    """``pl.pallas_call`` with the repo-wide dispatch conventions.
+
+    ``interpret=None`` resolves via :func:`auto_interpret` (native on
+    TPU, interpreter on the CPU test backend); an explicit bool is
+    passed through untouched (callers that already resolved it — e.g.
+    through ``RAFTConfig.pallas_offtpu`` — stay in charge).  All other
+    keyword arguments are forwarded to ``pl.pallas_call``.
+    """
+    if interpret is None:
+        interpret = auto_interpret()
+    return pl.pallas_call(
+        kernel,
+        compiler_params=compiler_params(vmem_limit_mb),
+        interpret=interpret,
+        **kw)

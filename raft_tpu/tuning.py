@@ -70,6 +70,7 @@ TUNABLE_KNOBS = (
     "lookup_block_q", "remat", "remat_policy", "scan_unroll",
     "remat_upsample", "upsample_dtype", "upsample_group",
     "upsample_unroll", "upsample_loss_kernel", "fuse_upsample_in_scan",
+    "fused_lookup_encoder", "fused_gru",
 )
 
 # ServeConfig-level knobs a kind='serve' entry may additionally carry

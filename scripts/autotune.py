@@ -158,6 +158,11 @@ def _grid(kind: str, tiny: bool, allow_quantized: bool):
     import jax
 
     on_tpu = jax.default_backend() == "tpu"
+    # The fused Pallas kernels (PR 13) are real sweep axes only where
+    # they can dispatch; off-TPU they resolve to the unfused paths, so a
+    # single-value axis keeps them visible in the grid (and the
+    # sweep_id) without duplicating measurements.
+    fused_axis = [False, True] if on_tpu else [False]
     if kind == "serve":
         if tiny:
             return {"batching": ["request", "slot"], "slots": [2]}
@@ -165,9 +170,16 @@ def _grid(kind: str, tiny: bool, allow_quantized: bool):
             "batching": ["request", "slot"],
             "slots": [4, 8, 16],
             "early_exit_threshold": [0.0, 0.05, 0.2],
+            "fused_gru": fused_axis,
         }
     if tiny:
-        return {"scan_unroll": [1, 2]}
+        # Keep the tiny sweep at 4 points: both fused knobs ride the
+        # sweep -> save_entry -> resolve_config loop (they resolve to
+        # the unfused paths on the CPU smoke backend — this is plumbing
+        # coverage, not a kernel measurement).
+        return {"scan_unroll": [1],
+                "fused_lookup_encoder": [False, True],
+                "fused_gru": [False, True]}
     if kind == "eval":
         grid = {
             "corr_impl": (["allpairs", "allpairs_pallas", "pallas"]
@@ -185,6 +197,8 @@ def _grid(kind: str, tiny: bool, allow_quantized: bool):
         "remat": [False, True],
         "remat_upsample": [False, True],
         "fuse_upsample_in_scan": [False, True],
+        "fused_lookup_encoder": fused_axis,
+        "fused_gru": fused_axis,
     }
     if on_tpu:
         grid["upsample_loss_kernel"] = ["xla", "pallas"]
@@ -196,13 +210,16 @@ def _points(grid: dict, seed: int):
     pts = [dict(zip(keys, vals))
            for vals in itertools.product(*(grid[k] for k in keys))]
     if "batching" in grid:
-        # Request mode ignores the slot-mode knobs: collapse every
-        # batching=request cross-product point to ONE canonical
-        # measurement instead of re-timing the identical config.
+        # Request mode ignores the slot-mode dispatcher knobs: collapse
+        # those axes for every batching=request cross-product point
+        # instead of re-timing identical configs.  Model-level knobs
+        # (fused_gru etc.) affect BOTH batching modes, so they survive
+        # the collapse.
+        slot_only = ("slots", "early_exit_threshold")
         seen, uniq = set(), []
         for p in pts:
             if p.get("batching") == "request":
-                p = {"batching": "request"}
+                p = {k: v for k, v in p.items() if k not in slot_only}
             key = json.dumps(p, sort_keys=True)
             if key not in seen:
                 seen.add(key)
@@ -314,12 +331,16 @@ def _time_serve_point(knobs, hw, batch, iters, steps, warmup, seed,
     import jax
     import numpy as np
 
+    from raft_tpu import tuning
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models.raft import RAFT
     from raft_tpu.serve.engine import InferenceEngine, ServeConfig
 
     mk = RAFTConfig.small_model if tiny else RAFTConfig.full
-    model_cfg = mk()
+    # Model-level knobs in a serve sweep (fused_gru etc.) configure the
+    # RAFTConfig the engine compiles; dispatcher knobs go to ServeConfig.
+    model_cfg = mk(**{k: knobs[k] for k in tuning.TUNABLE_KNOBS
+                      if k in knobs})
     H, W = hw
     serve_kw = {k: knobs[k] for k in ("batching", "slots",
                                       "early_exit_threshold")

@@ -103,6 +103,18 @@ def parse_args(argv=None):
                         "repeatable.  Also fails when NO record carries "
                         "the figure — a latency gate must not pass "
                         "because tracing silently turned off")
+    p.add_argument("--max-kernel-slowdown", action="append",
+                   default=[], metavar="NAME:PCT",
+                   help="fail when a newest bench_kernels record shows "
+                        "fused kernel NAME (config.kernels[NAME], from "
+                        "scripts/bench_kernels.py) more than PCT%% "
+                        "slower than its unfused arm WHILE the tuning "
+                        "registry selects it on this device "
+                        "(kernels[NAME].selected); repeatable.  Also "
+                        "fails when NO non-interpret record carries the "
+                        "figure — a kernel-perf gate must not pass "
+                        "because the microbench silently didn't run "
+                        "(interpret-mode smoke records don't count)")
     p.add_argument("--require-tuned", action="store_true",
                    help="fail when a newest record's config lacks "
                         "`tuned: true` — i.e. its knobs did NOT come "
@@ -155,29 +167,36 @@ def build_series(paths):
 SERVE_REQUIRED_SPANS = ("queue", "pad", "device")
 
 
-def parse_cp_gates(items):
+def parse_named_gates(items, flag, example):
     """``["device:50", ...] -> {"device": 50.0}``."""
     gates = {}
     for item in items or []:
-        name, sep, ms = str(item).rpartition(":")
+        name, sep, val = str(item).rpartition(":")
         try:
             if not sep or not name:
                 raise ValueError
-            gates[name] = float(ms)
+            gates[name] = float(val)
         except ValueError:
-            raise SystemExit(f"--max-critical-path-ms expects NAME:MS "
-                             f"(e.g. device:50), got {item!r}")
+            raise SystemExit(f"{flag} expects NAME:{example[0]} "
+                             f"(e.g. {example[1]}), got {item!r}")
     return gates
+
+
+def parse_cp_gates(items):
+    return parse_named_gates(items, "--max-critical-path-ms",
+                             ("MS", "device:50"))
 
 
 def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
           max_quarantined=0, max_ckpt_fallback=0, require_tuned=False,
           max_serve_error_rate=0.0, max_critical_path_ms=None,
-          max_early_exit_epe_delta=None):
+          max_early_exit_epe_delta=None, max_kernel_slowdown=None):
     """``(failures, report)`` over the newest record of each metric."""
     failures, report = [], []
     cp_gates = dict(max_critical_path_ms or {})
     cp_seen = set()
+    ker_gates = dict(max_kernel_slowdown or {})
+    ker_seen = set()
     ee_seen = False
     for metric, recs in sorted(series.items()):
         newest = recs[-1]
@@ -236,6 +255,32 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
                         failures.append(
                             f"{metric}: critical-path {name} p95 "
                             f"{v:g}ms > budget {budget:g}ms")
+        # Fused-kernel perf gate (scripts/bench_kernels.py records): the
+        # tuning registry must not keep SELECTING a fused kernel that
+        # the microbench shows slower than its unfused arm on this
+        # device.  Interpret-mode smoke records are skipped — the
+        # interpreter's timings say nothing about hardware.
+        kers = cfg.get("kernels")
+        if isinstance(kers, dict) and not cfg.get("interpret"):
+            for name, budget in ker_gates.items():
+                k = kers.get(name)
+                if not isinstance(k, dict):
+                    continue
+                fu, un = k.get("fused_ms"), k.get("unfused_ms")
+                if (isinstance(fu, (int, float))
+                        and isinstance(un, (int, float)) and un > 0):
+                    ker_seen.add(name)
+                    if k.get("selected"):
+                        slow = (fu / un - 1.0) * 100.0
+                        if slow > budget:
+                            failures.append(
+                                f"{metric}: fused kernel {name!r} is "
+                                f"{slow:.1f}% slower than unfused "
+                                f"({fu:g}ms vs {un:g}ms, budget "
+                                f"{budget:g}%) yet the tuning registry "
+                                f"selects it "
+                                f"({k.get('selected_kind')}) — re-run "
+                                "scripts/autotune.py on this device")
         # Early-exit accuracy gate: iterations saved by the convergence
         # cut (docs/SERVING.md) must stay within the EPE budget the
         # sweep measured (evaluate.py --early_exit_threshold).
@@ -291,6 +336,12 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
             f"critical-path gate {name!r}: no record carries "
             f"config.critical_path_ms[{name!r}] — tracing is off or "
             "the span never appeared; the gate cannot pass vacuously")
+    for name in sorted(set(ker_gates) - ker_seen):
+        failures.append(
+            f"kernel gate {name!r}: no non-interpret record carries "
+            f"config.kernels[{name!r}] timings — the microbench "
+            "(scripts/bench_kernels.py) did not run on hardware; the "
+            "gate cannot pass vacuously")
     if max_early_exit_epe_delta is not None and not ee_seen:
         failures.append(
             "early-exit gate: no record carries "
@@ -436,6 +487,36 @@ def _selftest() -> int:
         ("early-exit delta without the gate passes",
          run([30.0, 31.0, 30.5],
              last_cfg={"early_exit_epe_delta": 9.0}), False),
+        ("selected fused kernel within budget passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"kernels": {"gru": {
+                 "fused_ms": 9.0, "unfused_ms": 10.0, "selected": True}}},
+             max_kernel_slowdown={"gru": 5.0}), False),
+        ("selected fused kernel slower fails",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"kernels": {"gru": {
+                 "fused_ms": 12.0, "unfused_ms": 10.0,
+                 "selected": True, "selected_kind": "train"}}},
+             max_kernel_slowdown={"gru": 5.0}), True),
+        ("unselected slower fused kernel passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"kernels": {"gru": {
+                 "fused_ms": 12.0, "unfused_ms": 10.0,
+                 "selected": False}}},
+             max_kernel_slowdown={"gru": 5.0}), False),
+        ("kernel gate without record fails",
+         run([30.0, 31.0, 30.5], max_kernel_slowdown={"gru": 5.0}),
+         True),
+        ("interpret-only kernel record fails the gate",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"interpret": True, "kernels": {"gru": {
+                 "fused_ms": 9.0, "unfused_ms": 10.0, "selected": True}}},
+             max_kernel_slowdown={"gru": 5.0}), True),
+        ("slow kernel record without the gate passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"kernels": {"gru": {
+                 "fused_ms": 99.0, "unfused_ms": 10.0,
+                 "selected": True}}}), False),
     ]
 
     def run_lint(payload):
@@ -497,7 +578,11 @@ def main(argv=None):
                              max_critical_path_ms=parse_cp_gates(
                                  args.max_critical_path_ms),
                              max_early_exit_epe_delta=(
-                                 args.max_early_exit_epe_delta))
+                                 args.max_early_exit_epe_delta),
+                             max_kernel_slowdown=parse_named_gates(
+                                 args.max_kernel_slowdown,
+                                 "--max-kernel-slowdown",
+                                 ("PCT", "gru:5")))
     if args.lint_report:
         failures.extend(lint_gate(args.lint_report))
     print(json.dumps({"ok": not failures, "failures": failures,

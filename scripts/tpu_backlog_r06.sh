@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-6 TPU backlog, priority order: the PR-13 fused-kernel
+# re-baseline.  The fused Pallas kernels (fused_lookup_encoder,
+# fused_gru) were interpret-verified off-TPU only — this round measures
+# them on hardware, lets autotune rank them into the registry, and
+# re-runs the headline bench with the refreshed knob surface.  Every
+# step is independently resumable.
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+
+# 0. Per-kernel microbench, fused vs unfused arms in isolation, at the
+#    chairs train shape and the serving shape -> BENCH_KERNELS_r06*.json
+#    (selected=false on a fresh chip: the registry has no fused winners
+#    yet; step 3 re-runs it post-autotune so the slowdown gate is armed)
+python scripts/bench_kernels.py --image 368x496 --batch 16 \
+    2>&1 | tee /tmp/bench_kernels_r06.log | tail -1 \
+    > BENCH_KERNELS_r06_pre.json
+python scripts/bench_kernels.py --image 440x1024 --batch 8 \
+    --corr-dtype int8 2>&1 | tee /tmp/bench_kernels_serve_r06.log \
+    | tail -1 > BENCH_KERNELS_SERVE_r06_pre.json
+
+# 1. Autotune sweeps with both fused kernels in the knob surface
+#    (fused_lookup_encoder/fused_gru are real [False, True] axes on
+#    TPU) — train at the chairs crop, eval + serve at the serving shape
+python scripts/autotune.py --kind train --image 368x496 \
+    --batch-per-chip 16 2>&1 | tee /tmp/autotune_train_r06.log | tail -3
+python scripts/autotune.py --kind eval --image 440x1024 \
+    --batch-per-chip 8 2>&1 | tee /tmp/autotune_eval_r06.log | tail -3
+python scripts/autotune.py --kind serve --image 440x1024 \
+    --batch-per-chip 8 2>&1 | tee /tmp/autotune_serve_r06.log | tail -3
+
+# 2. Headline bench + eval refresh on the tuned registry (the r03-era
+#    76.0 pairs/s/chip pin plus whatever the fused kernels buy)
+python bench.py 2>&1 | tee /tmp/bench_r06.log | tail -2
+BENCH_MODE=eval python bench.py 2>&1 | tee /tmp/bench_eval_r06.log | tail -2
+
+# 3. Post-autotune kernel re-bench: now `selected` reflects the
+#    registry's verdict, which arms the regression gate — a registry
+#    that picked a fused kernel the microbench shows slower fails here
+python scripts/bench_kernels.py --image 368x496 --batch 16 \
+    2>&1 | tee /tmp/bench_kernels_r06_post.log | tail -1 \
+    > BENCH_KERNELS_r06.json
+python scripts/check_regression.py BENCH_KERNELS_r06.json \
+    --max-kernel-slowdown lookup_encoder:5 --max-kernel-slowdown gru:5 \
+    2>&1 | tail -3
+
+# 4. Serve parity + throughput with the tuned knobs (fused_gru rides
+#    the compiled encode/iter_step pieces in both batching modes)
+python scripts/bench_serve.py --batching both --shapes 440x1024 \
+    --requests 128 --concurrency 16 \
+    2>&1 | tee /tmp/bench_serve_r06.log | tail -1 > BENCH_SERVE_r06.json

@@ -1,0 +1,61 @@
+"""scripts/bench_kernels.py --tiny: the tier-1 CPU interpret smoke.
+
+Runs both fused kernels' microbench arms (fused vs unfused) once in
+interpret mode and checks the one-line bench.py-format record — the
+same record shape ``check_regression.py --max-kernel-slowdown`` gates
+on, so this pins the producer side of that contract.
+"""
+
+import importlib.util
+import json
+import os.path as osp
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, osp.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_kernels_tiny_smoke(capsys):
+    mod = _load_script("bench_kernels")
+    mod.main(["--tiny"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    assert rec["metric"] == "kernel_fused_speedup_min"
+    assert rec["unit"] == "x" and rec["value"] > 0
+    cfg = rec["config"]
+    assert cfg["tiny"] is True and cfg["interpret"] is True
+    kers = cfg["kernels"]
+    assert set(kers) == {"lookup_encoder", "gru"}
+    for k in kers.values():
+        assert k["fused_ms"] > 0 and k["unfused_ms"] > 0
+        assert k["speedup"] > 0
+        # interpret-mode smoke: the registry must not claim a fused
+        # selection on the CPU backend (nothing to re-baseline here)
+        assert k["selected"] is False and k["selected_kind"] is None
+
+    # the record feeds the kernel-slowdown gate: interpret smoke
+    # records must NOT satisfy it (no vacuous hardware passes) ...
+    cr = _load_script("check_regression")
+    failures, _ = cr.check({"kernel_fused_speedup_min": [rec]},
+                           max_kernel_slowdown={"gru": 5.0})
+    assert any("no non-interpret record" in f for f in failures)
+    # ... while a hardware-shaped record with the same layout does.
+    hw = dict(rec, config=dict(cfg, interpret=False))
+    failures, _ = cr.check({"kernel_fused_speedup_min": [hw]},
+                           max_kernel_slowdown={"gru": 5.0,
+                                                "lookup_encoder": 5.0})
+    assert not failures
+
+
+def test_bench_kernels_rejects_unknown_kernel():
+    import pytest
+
+    mod = _load_script("bench_kernels")
+    with pytest.raises(SystemExit):
+        mod.main(["--tiny", "--kernels", "nope"])

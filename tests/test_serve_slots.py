@@ -371,3 +371,23 @@ def test_bench_serve_workload_and_preset():
         mod.parse_args(["--batching", "slot", "--replicas", "2"])
     with pytest.raises(SystemExit):
         mod.parse_args(["--easy-frac", "1.5"])
+
+
+def test_slot_parity_with_fused_gru(variables, request_flows):
+    """``fused_gru=True`` (interpret-mode Pallas gate chains) through
+    the slot engine matches the unfused request-mode oracle to float
+    tolerance — the fused kernel slots into serve's compiled
+    ``encode``/``iter_step`` pieces without touching the batching,
+    masking, or lane-independence contracts (PR-13 acceptance)."""
+    pairs, ref = request_flows
+    cfg = CFG.replace(fused_gru=True, pallas_offtpu="interpret")
+    assert cfg.resolved_fused_gru is True
+    eng = InferenceEngine(variables, cfg, ServeConfig(
+        iters=ITERS, batching="slot", slots=4, max_wait_ms=15))
+    with eng:
+        futs = [eng.submit(a, b) for a, b in pairs]
+        got = [f.result(timeout=120) for f in futs]
+        stats = eng.stats()
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(r, g, rtol=1e-5, atol=1e-5)
+    assert stats["batching"] == "slot" and stats["completed"] == 4
