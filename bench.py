@@ -104,6 +104,12 @@ def bench_eval():
     from raft_tpu import tuning
 
     _, tinfo = tuning.resolve_config(cfg, ("eval",), (H, W), 1)
+    # Work accounting off the already-compiled forward (the capture is
+    # an AOT re-lower of the same jit — a cache hit, host-side only).
+    cost = fwd.capture_cost(variables, img, img)
+    frame_s = dt / n
+    at = cost.achieved_tflops(frame_s)
+    m = cost.mfu(frame_s)
     print(json.dumps({
         "metric": f"eval_forward_sintel_440x1024_bf16_iters{iters}",
         "value": round(n / dt, 3),
@@ -111,7 +117,12 @@ def bench_eval():
         "vs_baseline": (round(n / dt / eval_target, 3) if eval_target
                         else 0.0),
         "baseline_frames_per_sec": eval_target or "n/a (non-default cfg)",
-        "config": dict(tinfo.stamp()),
+        "config": dict(
+            tinfo.stamp(),
+            flops_per_pair=cost.flops_per_pair,
+            achieved_tflops=round(at, 4) if at is not None else None,
+            mfu=round(m, 4) if m is not None else None,
+            bound_by=cost.bound_by, cost_source=cost.source),
     }))
 
 
@@ -230,17 +241,25 @@ def main():
     }, mesh)
     key = jax.random.PRNGKey(1)
 
-    # Warmup (compile) + 2 steady-state steps.  float() forces a real
-    # device sync (block_until_ready alone has proven unreliable on the
-    # tunneled platform).
+    # AOT-compile once: the SAME executable is timed below and queried
+    # for compile-time FLOPs/bytes (raft_tpu/obs/cost.py) — work
+    # accounting costs zero extra compiles and zero device syncs.
+    from raft_tpu.train.step import step_cost
+
+    compiled = step_fn.lower(state, batch, key).compile()
+    cost = step_cost(compiled, B, n_dev)
+
+    # Warmup + 2 steady-state steps.  float() forces a real device sync
+    # (block_until_ready alone has proven unreliable on the tunneled
+    # platform).
     for _ in range(3):
-        state, metrics = step_fn(state, batch, key)
+        state, metrics = compiled(state, batch, key)
     float(metrics["loss"])
 
     n_steps = 10
     t0 = time.perf_counter()
     for _ in range(n_steps):
-        state, metrics = step_fn(state, batch, key)
+        state, metrics = compiled(state, batch, key)
     float(metrics["loss"])
     dt = time.perf_counter() - t0
 
@@ -249,6 +268,20 @@ def main():
     # (BASELINE.json); the ratio is meaningless for other shapes.
     vs = (pairs_per_sec_per_chip / BASELINE_PAIRS_PER_SEC_PER_CHIP
           if _stage_name(H, W) == "flyingchairs" else 0.0)
+    # Hardware-normalized work figures: flops_per_pair is mesh-shape-
+    # invariant (per-device flops over per-device pairs), MFU/bound_by
+    # normalize throughput by the device peak (None on unknown peaks,
+    # e.g. CPU — check_regression --min-mfu skips those records).
+    step_s = dt / n_steps
+    at = cost.achieved_tflops(step_s)
+    m = cost.mfu(step_s)
+    cost_fields = {
+        "flops_per_pair": cost.flops_per_pair,
+        "achieved_tflops": round(at, 4) if at is not None else None,
+        "mfu": round(m, 4) if m is not None else None,
+        "bound_by": cost.bound_by,
+        "cost_source": cost.source,
+    }
     print(json.dumps({
         "metric": _train_metric_name(H, W),
         "value": round(pairs_per_sec_per_chip, 3),
@@ -270,7 +303,7 @@ def main():
                    "scan_unroll": scan_unroll,
                    "fuse_upsample_in_scan": model_cfg.fuse_upsample_in_scan,
                    "upsample_loss_kernel": model_cfg.upsample_loss_kernel,
-                   **tuning_stamp},
+                   **cost_fields, **tuning_stamp},
     }))
 
 

@@ -30,6 +30,8 @@ _SUBCOMMANDS = {
                    "RAFT vs Lucas-Kanade side-by-side"),
     "lint": ("raft_tpu.cli.lint",
              "raftlint static analysis (docs/ANALYSIS.md)"),
+    "cost": ("raft_tpu.cli.cost",
+             "per-program FLOPs/bytes/roofline cost table"),
 }
 
 

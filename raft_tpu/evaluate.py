@@ -96,11 +96,41 @@ def make_eval_fn(model_cfg: RAFTConfig, iters: int):
         return model.apply(variables, image1, image2, iters=iters,
                            flow_init=flow_init, test_mode=True, train=False)
 
+    def capture_cost(variables, image1, image2):
+        """Compile-time cost of the no-init forward at this shape
+        (obs/cost.py) — one extra ``lower().compile()``, cheap under
+        the persistent compile cache; host metadata only.  The cost
+        CLI and bench.py's eval arm call this directly."""
+        from raft_tpu.obs import cost as cost_mod
+
+        compiled = fwd.lower(variables, image1, image2).compile()
+        h, w = image1.shape[1], image1.shape[2]
+        return cost_mod.program_cost(
+            compiled, program=f"inference_{h}x{w}",
+            pairs_per_call=image1.shape[0])
+
+    # One cost_report per distinct compiled shape when telemetry is on
+    # (the validators stream constant-shape batches, so this fires once
+    # per split) — the hbm_usage precedent, RAFT_TELEMETRY_COST=0 skips.
+    cost_seen: set = set()
+    cost_on = os.environ.get("RAFT_TELEMETRY_COST", "1") == "1"
+
     def eval_fn(variables, image1, image2, flow_init=None):
+        if cost_on and flow_init is None \
+                and image1.shape not in cost_seen:
+            cost_seen.add(image1.shape)
+            sink = default_sink()
+            if sink.enabled:
+                try:
+                    sink.emit("cost_report", **capture_cost(
+                        variables, image1, image2).as_record())
+                except Exception:
+                    pass
         if flow_init is None:
             return fwd(variables, image1, image2)
         return fwd_init(variables, image1, image2, flow_init)
 
+    eval_fn.capture_cost = capture_cost
     return eval_fn
 
 

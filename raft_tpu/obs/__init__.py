@@ -18,6 +18,13 @@ Three composable pieces, shared by train/eval/serve:
 Hot-path contract: recording is lock-cheap, never forces a device
 sync, and the whole layer is a no-op when disabled.
 
+Cost-model accounting lives in ``obs.cost`` (imported directly, like
+the health modules): per-compiled-program FLOPs/bytes from XLA's
+``cost_analysis()`` with analytic Pallas fallbacks, the per-device-kind
+peak table, and the MFU / roofline derivations behind the
+``raft_cost_*`` gauges and ``cost_report`` events
+(docs/OBSERVABILITY.md → "Cost model & roofline").
+
 Training health lives in the sibling modules (imported directly, not
 re-exported, to keep this package import light): ``obs.health`` — the
 in-graph non-finite guard helpers, the host-side :class:`HealthMonitor`

@@ -30,9 +30,13 @@ One-time events: ``run_config`` (what scripts/telemetry_summary.py
 needs to fold the log into bench.py JSON), ``compile`` (the first
 executed step's dispatch time, which is dominated by trace+compile; the
 :class:`~raft_tpu.utils.profiling.CompileCounter` is wired into the
-registry), and ``hbm_usage`` (XLA memory analysis of the compiled step;
+registry), ``hbm_usage`` (XLA memory analysis of the compiled step;
 costs one extra ``lower().compile()`` at startup, disable with
-``RAFT_TELEMETRY_HBM=0``).  ``close()`` emits a ``metrics_summary``
+``RAFT_TELEMETRY_HBM=0``), and ``cost_report`` (the compiled step's
+FLOPs/bytes/roofline accounting from obs/cost.py, sharing that same
+extra compile; disable with ``RAFT_TELEMETRY_COST=0`` — per-step MFU
+then refreshes through the ``raft_cost_mfu`` gauge from each step's
+wall time, still host floats only).  ``close()`` emits a ``metrics_summary``
 with the full registry snapshot so a run's aggregates survive in the
 same JSONL file as its per-step stream.
 """
@@ -43,6 +47,7 @@ import collections
 import os
 from typing import List, Optional, Sequence, Tuple
 
+from raft_tpu.obs import cost as cost_mod
 from raft_tpu.obs.events import EventSink
 from raft_tpu.obs.registry import MetricRegistry
 from raft_tpu.utils.profiling import CompileCounter
@@ -70,6 +75,13 @@ class TrainTelemetry:
         if hbm is None:
             hbm = os.environ.get("RAFT_TELEMETRY_HBM", "1") == "1"
         self.hbm_enabled = self.enabled and hbm
+        # Cost-model capture (obs/cost.py) shares the hbm_usage
+        # pattern AND its one extra lower().compile() in the loop —
+        # disable with RAFT_TELEMETRY_COST=0.
+        self.cost_enabled = self.enabled and (
+            os.environ.get("RAFT_TELEMETRY_COST", "1") == "1")
+        self._cost_book = cost_mod.CostBook(registry=self.registry,
+                                            sink=self.sink)
         self.compile_counter = CompileCounter(
             registry=self.registry, metric="raft_train_compiles_total")
         self._step_hist = self.registry.histogram(
@@ -139,6 +151,11 @@ class TrainTelemetry:
         self._h2d_hist.observe(h2d_s)
         self._prep_hist.observe(prep_s)
         self._pps.set(pps)
+        # MFU from the device-time proxy (step minus input wait; once
+        # the pipeline fills this converges to device step time) — a
+        # no-op {} until record_cost stamped the compiled step.
+        self._cost_book.observe(
+            "train_step", max(step_time_s - queue_wait_s, 1e-9))
         rec = dict(step=step,
                    step_time_s=round(step_time_s, 6),
                    queue_wait_s=round(queue_wait_s, 6),
@@ -200,6 +217,15 @@ class TrainTelemetry:
                 "raft_train_peak_hbm_gb",
                 "compiled step's XLA peak device allocation").set(peak)
         self.sink.emit("hbm_usage", **info)
+
+    def record_cost(self, cost) -> None:
+        """Stamp the compiled train step's :class:`obs.cost.ProgramCost`
+        — one ``cost_report`` event + the ``raft_cost_*`` gauges; from
+        then on every ``record_step`` refreshes MFU/BW utilization from
+        the step's measured wall time (host floats only)."""
+        if not self.enabled:
+            return
+        self._cost_book.stamp("train_step", cost)
 
     def close(self) -> None:
         if self.enabled:

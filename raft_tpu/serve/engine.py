@@ -80,6 +80,7 @@ from raft_tpu.chaos import (InjectedDeviceError, InjectedReplicaKill,
                             ReplicaWedgedInterrupt, is_transient_error)
 from raft_tpu.config import RAFTConfig
 from raft_tpu.obs import EventSink, MetricRegistry
+from raft_tpu.obs import cost as cost_mod
 from raft_tpu.obs import trace
 from raft_tpu.ops.pad import InputPadder, bucket_hw
 from raft_tpu.serve.stats import Counters, LatencyRecorder
@@ -393,6 +394,12 @@ class InferenceEngine:
                  "retiring (early exit / per-request budget)",
             scale=1.0, suffix="")
         self._counters = Counters(registry=self.registry)
+        # Compile-time work accounting, keyed by the SAME (bucket,
+        # lanes, prog) ledger keys as _executables: stamped once in
+        # _get_programs, read back by spans/stats with zero device
+        # work (obs/cost.py; docs/OBSERVABILITY.md "Cost model").
+        self.cost_book = cost_mod.CostBook(registry=self.registry,
+                                           sink=self._sink)
         self._pending_gauge = self.registry.gauge(
             "raft_serve_pending_requests", "requests in flight")
         self.registry.add_collect_hook(self._collect_pending)
@@ -723,6 +730,14 @@ class InferenceEngine:
         # AOT warm-start provenance: how many executables this engine
         # imported instead of compiling (docs/SERVING.md fleet section).
         out["aot"] = dict(self.aot_info)
+        # Compile-time work accounting per ledger key (obs/cost.py):
+        # the `raft_tpu cost` table and bench_serve's per-pair stamps
+        # read this — flops/bytes/roofline, captured once at compile.
+        out["cost"] = {
+            f"{hw[0]}x{hw[1]}/b{bs}/{prog}": c.as_record()
+            for (hw, bs, prog), c in sorted(
+                self.cost_book.table().items())
+        }
         return out
 
     # ------------------------------------------------------------------
@@ -856,10 +871,41 @@ class InferenceEngine:
                     self._variables, state_spec, thr).compile()
                 self._executables[(bucket, lanes, "iter")] = it
                 self.compile_counter.record((bucket, lanes, "iter"))
+            # Stamp compile-time cost under the executables' own ledger
+            # keys — pure host metadata off the Compiled objects (works
+            # for AOT-imported executables too; never runs the program).
+            for prog, exe in (("enc", enc), ("iter", it)):
+                key = (bucket, lanes, prog)
+                if self.cost_book.get(key) is None:
+                    self.cost_book.stamp(key, cost_mod.program_cost(
+                        exe, program=f"serve_{prog}_{H}x{W}_b{lanes}",
+                        pairs_per_call=lanes))
             progs = _Programs(enc, it, template, bucket, lanes,
                               self.cfg.iters)
             self._programs[pkey] = progs
             return progs
+
+    def _pipeline_cost_attrs(self, bucket: tuple, lanes: int,
+                             iters: int, seconds: float) -> dict:
+        """Trace-span cost attrs for one request-mode pipeline call
+        (``enc`` + ``iters`` x ``iter`` over the stamped ledger
+        entries): ``flops``/``bytes`` always, ``mfu`` when the device
+        peak is known.  ``{}`` before the programs are stamped."""
+        enc = self.cost_book.get((bucket, lanes, "enc"))
+        it = self.cost_book.get((bucket, lanes, "iter"))
+        if enc is None or it is None:
+            return {}
+        total = cost_mod.ProgramCost(
+            program=f"serve_pipeline_{bucket[0]}x{bucket[1]}_b{lanes}",
+            flops=enc.flops + iters * it.flops,
+            bytes=enc.bytes + iters * it.bytes,
+            pairs_per_call=lanes, source=enc.source,
+            device_kind=enc.device_kind)
+        attrs = {"flops": total.flops, "bytes": total.bytes}
+        m = total.mfu(seconds)
+        if m is not None:
+            attrs["mfu"] = round(m, 4)
+        return attrs
 
     def _get_executable(self, bucket: tuple, batch_size: int):
         """Request-mode device callable for one ``(bucket, batch)``:
@@ -1032,6 +1078,8 @@ class InferenceEngine:
             if traced:
                 retries = self._last_retries
                 bk = f"{bucket[0]}x{bucket[1]}"
+                cost_attrs = self._pipeline_cost_attrs(
+                    bucket, bs, self.cfg.iters, t_done - t_pad1)
                 for r in traced:
                     trace.record_span(r.trace, "queue", r.t_submit,
                                       t_start, batch=self._batch_seq)
@@ -1039,7 +1087,7 @@ class InferenceEngine:
                                       real=n, ballast=bs - n)
                     trace.record_span(r.trace, "device", t_pad1, t_done,
                                       bucket=bk, batch=self._batch_seq,
-                                      retries=retries)
+                                      retries=retries, **cost_attrs)
                     if retries:  # tail-keep: a retried batch is news
                         r.trace.mark_keep()
         except Exception as e:
@@ -1223,13 +1271,18 @@ class InferenceEngine:
         # Iteration-level trace attribution: every traced request that
         # was active this cycle gets an iter_step child span under its
         # request root (trace_report.py critical paths then show which
-        # iterations a request actually waited on).
+        # iterations a request actually waited on).  The cost attrs
+        # (flops/bytes, mfu on known peaks) come from the ledger entry
+        # stamped at compile time — observe() also refreshes the
+        # raft_cost_mfu/raft_cost_hbm_bw_util gauges, no device work.
+        iter_attrs = self.cost_book.observe(
+            (bucket, self.cfg.slots, "iter"), t_done - t0)
         for i in np.nonzero(prev_active)[0]:
             r = pool.reqs[int(i)]
             if r is not None and r.trace is not None:
                 trace.record_span(r.trace, "iter_step", t0, t_done,
                                   batch=seq, slot=int(i),
-                                  active=n_active)
+                                  active=n_active, **iter_attrs)
         newly = prev_active & ~active
         if not newly.any():
             return

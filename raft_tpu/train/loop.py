@@ -44,7 +44,7 @@ from raft_tpu.train.logger import Logger
 from raft_tpu.train.loss import sequence_loss  # noqa: F401 (re-export)
 from raft_tpu.train.optim import make_optimizer, schedule_of
 from raft_tpu.train.state import TrainState
-from raft_tpu.train.step import init_state, make_train_step
+from raft_tpu.train.step import init_state, make_train_step, step_cost
 from raft_tpu.utils.profiling import StepProfiler, annotate_step, hbm_usage
 
 # Cooperative preemption: a SIGTERM handler (cli/train.py) sets this and
@@ -380,13 +380,27 @@ def train(model_cfg: RAFTConfig, cfg: TrainConfig,
                     step - 1, step_time_s,
                     key=("train_step", tuple(cfg.image_size),
                          cfg.batch_size))
-                if telem.hbm_enabled:
-                    # XLA memory analysis of the compiled step (one
-                    # extra lower+compile at startup; cheap under the
-                    # persistent compile cache, RAFT_TELEMETRY_HBM=0
-                    # skips it).  Purely host-side, runs once.
-                    telem.record_hbm(hbm_usage(step_fn, state, sharded,
-                                               key))
+                if telem.hbm_enabled or telem.cost_enabled:
+                    # XLA memory + cost analysis of the compiled step:
+                    # ONE extra lower+compile at startup shared by both
+                    # (cheap under the persistent compile cache;
+                    # RAFT_TELEMETRY_HBM=0 / RAFT_TELEMETRY_COST=0 skip
+                    # each half).  Purely host-side, runs once.  A
+                    # non-lowerable step_fn (stubbed in tests) degrades
+                    # to the unavailable record, never a loop failure.
+                    try:
+                        compiled = step_fn.lower(state, sharded,
+                                                 key).compile()
+                    except Exception:
+                        compiled = None
+                    if telem.hbm_enabled:
+                        telem.record_hbm(
+                            hbm_usage(compiled) if compiled is not None
+                            else {"peak_hbm": "unavailable"})
+                    if telem.cost_enabled and compiled is not None:
+                        telem.record_cost(step_cost(
+                            compiled, cfg.batch_size,
+                            telem.num_devices))
                 if watchdog is not None:
                     watchdog.resume()  # compile window over
             telem.record_step(step - 1, step_time_s, queue_wait_s,

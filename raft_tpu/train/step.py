@@ -229,4 +229,21 @@ def make_train_step(model: RAFT, tx: optax.GradientTransformation,
     )
 
 
+def step_cost(compiled, batch_size: int, num_devices: int):
+    """:class:`~raft_tpu.obs.cost.ProgramCost` of a compiled train step.
+
+    The compiled module is the PER-DEVICE program under SPMD, so its
+    flops advance ``batch / num_devices`` pairs — that is what makes
+    ``flops_per_pair`` mesh-shape-invariant (the figure the
+    ``--max-flops-per-pair-growth`` gate compares across runs).
+    Host-side metadata only; the compile site owns calling this
+    (train/loop.py first-dispatch block, bench.py's timed arm).
+    """
+    from raft_tpu.obs import cost as cost_mod
+
+    return cost_mod.program_cost(
+        compiled, program="train_step",
+        pairs_per_call=float(batch_size) / max(int(num_devices), 1))
+
+
 # The jitted test-mode forward lives in raft_tpu.evaluate.make_eval_fn.

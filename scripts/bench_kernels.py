@@ -121,12 +121,24 @@ def _bench_lookup_encoder(args, h8, w8, dims, interpret):
         return pallas_pyramid_lookup_encode(
             pyr, coords, w, b, r, 128, interpret)
 
+    # AOT-compile each arm once: the SAME executable is timed and
+    # cost-queried, so flops/bytes come free at compile time
+    # (obs/cost.py).  Analytic twin: the kernel's hand-derived formula,
+    # what real-TPU custom_call arms fall back to.
+    from raft_tpu.obs.cost import analytic_lookup_encode_cost
+
+    unfused_c = unfused.lower(coords, w, b).compile()
+    fused_c = fused.lower(coords, w, b).compile()
+    level_hw = [(max(h8 >> lv, 1), max(w8 >> lv, 1)) for lv in range(L)]
+    analytic = analytic_lookup_encode_cost(
+        B, level_hw, h8 * w8, r, F,
+        pyramid_bytes=jnp.dtype(args.corr_dtype).itemsize)
     return {
-        "unfused_ms": _time_ms(lambda: unfused(coords, w, b),
+        "unfused_ms": _time_ms(lambda: unfused_c(coords, w, b),
                                args.reps, args.warmup),
-        "fused_ms": _time_ms(lambda: fused(coords, w, b),
+        "fused_ms": _time_ms(lambda: fused_c(coords, w, b),
                              args.reps, args.warmup),
-    }
+    }, (unfused_c, fused_c, analytic)
 
 
 def _bench_gru(args, h8, w8, dims, interpret):
@@ -167,12 +179,23 @@ def _bench_gru(args, h8, w8, dims, interpret):
             [gru_gate_rh(r_raw, hstate, interpret), x], -1), wq, bq)
         return gru_gate_blend(z_raw, q_raw, hstate, interpret)
 
+    from raft_tpu.obs.cost import analytic_gru_gate_cost
+
+    unfused_c = unfused.lower(hstate, x).compile()
+    fused_c = fused.lower(hstate, x).compile()
+    # Analytic cost of the two Pallas gate kernels only (the 3x3 convs
+    # around them are XLA-counted even on TPU; the custom_call bodies
+    # are what XLA can't see there).
+    gshape = (B, h8, w8, hid)
+    rh = analytic_gru_gate_cost(gshape, "rh")
+    blend = analytic_gru_gate_cost(gshape, "blend")
+    analytic = (rh[0] + blend[0], rh[1] + blend[1])
     return {
-        "unfused_ms": _time_ms(lambda: unfused(hstate, x),
+        "unfused_ms": _time_ms(lambda: unfused_c(hstate, x),
                                args.reps, args.warmup),
-        "fused_ms": _time_ms(lambda: fused(hstate, x),
+        "fused_ms": _time_ms(lambda: fused_c(hstate, x),
                              args.reps, args.warmup),
-    }
+    }, (unfused_c, fused_c, analytic)
 
 
 _KNOB_BY_KERNEL = {"lookup_encoder": "fused_lookup_encoder",
@@ -196,6 +219,7 @@ def main(argv=None):
     args = parse_args(argv)
 
     from raft_tpu import tuning
+    from raft_tpu.obs import cost as cost_mod
 
     h, w = (int(x) for x in args.image.lower().split("x"))
     h8, w8 = h // 8, w // 8
@@ -212,13 +236,37 @@ def main(argv=None):
         if name not in bench_fns:
             raise SystemExit(f"unknown kernel {name!r}; "
                              f"choose from {sorted(bench_fns)}")
-        rec = bench_fns[name](args, h8, w8, dims, interpret)
+        rec, (unfused_c, fused_c, analytic) = bench_fns[name](
+            args, h8, w8, dims, interpret)
         rec["speedup"] = round(
             rec["unfused_ms"] / max(rec["fused_ms"], 1e-9), 3)
         rec["unfused_ms"] = round(rec["unfused_ms"], 4)
         rec["fused_ms"] = round(rec["fused_ms"], 4)
         rec["selected"], rec["selected_kind"] = _registry_selected(
             name, (h, w), args.batch)
+        # Per-arm cost accounting (obs/cost.py): XLA's count where it
+        # sees the body (interpret mode, unfused arm), the analytic
+        # formula on real-TPU custom_call arms; MFU only on known
+        # device peaks and non-interpret timings.
+        interp = bool(args.interpret)
+        for arm, exe in (("unfused", unfused_c), ("fused", fused_c)):
+            pc = cost_mod.program_cost(
+                exe, program=f"kernel_{name}_{arm}",
+                pairs_per_call=args.batch, interpret=interp,
+                analytic=analytic if arm == "fused" else None)
+            rec[f"{arm}_flops"] = pc.flops
+            rec[f"{arm}_bytes"] = pc.bytes
+            rec[f"{arm}_cost_source"] = pc.source
+            if arm == "fused":
+                rec["flops_per_pair"] = pc.flops_per_pair
+                secs = rec["fused_ms"] / 1e3
+                at = pc.achieved_tflops(secs)
+                rec["achieved_tflops"] = (round(at, 4)
+                                          if at is not None else None)
+                m = pc.mfu(secs)
+                rec["mfu"] = round(m, 4) if m is not None else None
+                rec["bound_by"] = pc.bound_by
+                rec["analytic_flops"], rec["analytic_bytes"] = analytic
         kernels[name] = rec
 
     print(json.dumps({

@@ -278,6 +278,40 @@ def _run_open(service, workload, rate, rng, out: "_Outcomes",
     return time.perf_counter() - t0
 
 
+def _arm_cost_fields(stats: dict, iters: int, value: float) -> dict:
+    """Hardware-normalized work figures for one arm, from the engine's
+    compile-time cost ledger (``stats()["cost"]``, obs/cost.py).
+
+    ``flops_per_pair`` is the full-budget pipeline (``enc`` +
+    ``iters`` x ``iter``) per pair, averaged over the compiled buckets;
+    ``achieved_tflops`` re-multiplies it by the measured pairs/sec/chip
+    (slot-mode early exit makes this the NOMINAL figure — a lane that
+    retires early did less work than stamped, so slot-mode MFU is an
+    upper bound).  ``mfu`` stays None on unknown device peaks (CPU),
+    which is what keeps those records out of ``--min-mfu``.
+    """
+    groups: dict = {}
+    for key, c in (stats.get("cost") or {}).items():
+        prefix, prog = key.rsplit("/", 1)
+        groups.setdefault(prefix, {})[prog] = c
+    fpps, peaks = [], []
+    for progs in groups.values():
+        enc, it = progs.get("enc"), progs.get("iter")
+        if not enc or not it or not enc.get("flops_per_pair"):
+            continue
+        fpps.append(enc["flops_per_pair"]
+                    + iters * it["flops_per_pair"])
+        peaks.append(enc.get("peak_tflops"))
+    if not fpps:
+        return {}
+    fpp = sum(fpps) / len(fpps)
+    achieved = value * fpp / 1e12
+    peak = peaks[0]
+    return {"flops_per_pair": round(fpp, 1),
+            "achieved_tflops": round(achieved, 4),
+            "mfu": round(achieved / peak, 4) if peak else None}
+
+
 def _run_arm(args, variables, model_cfg, workload, shapes,
              batching: str):
     """One batching arm over the shared workload: build the service,
@@ -370,6 +404,8 @@ def _run_arm(args, variables, model_cfg, workload, shapes,
         arm["occupancy"] = stats["occupancy"]
         arm["compiles"] = stats["compiles"]
         arm["iters_used"] = stats.get("iters_used")
+        arm["cost"] = stats.get("cost")
+        arm.update(_arm_cost_fields(stats, args.iters, arm["value"]))
     return arm
 
 
@@ -446,7 +482,8 @@ def main(argv=None):
     record.update({k: head[k] for k in
                    ("latency_ms", "rejected", "errors", "timeouts",
                     "error_rate", "retries_total", "occupancy",
-                    "compiles", "iters_used") if k in head})
+                    "compiles", "iters_used", "cost", "flops_per_pair",
+                    "achieved_tflops", "mfu") if k in head})
     for k in ("replicas", "router"):
         if k in head:
             record[k] = head[k]

@@ -122,9 +122,12 @@ def main(argv=None) -> int:
     os.makedirs(tdir, exist_ok=True)
 
     env_backup = {k: os.environ.get(k)
-                  for k in ("RAFT_TELEMETRY_DIR", "RAFT_TELEMETRY_HBM")}
+                  for k in ("RAFT_TELEMETRY_DIR", "RAFT_TELEMETRY_HBM",
+                            "RAFT_TELEMETRY_COST")}
     os.environ["RAFT_TELEMETRY_DIR"] = tdir
-    os.environ["RAFT_TELEMETRY_HBM"] = "0"  # skip the extra startup compile
+    # hbm + cost share one extra startup lower().compile() — skip it
+    os.environ["RAFT_TELEMETRY_HBM"] = "0"
+    os.environ["RAFT_TELEMETRY_COST"] = "0"
 
     from raft_tpu import chaos
     from raft_tpu.obs.events import reset_default_sink

@@ -115,6 +115,29 @@ def parse_args(argv=None):
                         "figure — a kernel-perf gate must not pass "
                         "because the microbench silently didn't run "
                         "(interpret-mode smoke records don't count)")
+    p.add_argument("--min-mfu", action="append", default=[],
+                   metavar="NAME:PCT",
+                   help="fail when the newest record of a metric series "
+                        "containing NAME posts MFU below PCT%% "
+                        "(config.mfu or top-level mfu, from the "
+                        "obs/cost.py accounting in bench.py / "
+                        "bench_serve.py / telemetry_summary.py); "
+                        "repeatable.  Interpret-mode records and "
+                        "records with no MFU (unknown device peak, "
+                        "e.g. CPU) never qualify — and a named gate "
+                        "with NO qualifying record fails (a "
+                        "hardware-utilization gate must not pass "
+                        "because the bench ran on the wrong backend)")
+    p.add_argument("--max-flops-per-pair-growth", type=float,
+                   default=None, metavar="PCT",
+                   help="fail when a newest record's flops_per_pair "
+                        "grew more than PCT%% over the prior-series "
+                        "median (work creep: a 'faster' number that "
+                        "quietly shrank shapes passes the throughput "
+                        "gate; one that grew work per pair should not "
+                        "slip through either).  Also fails when NO "
+                        "record carries flops_per_pair (unset = no "
+                        "check)")
     p.add_argument("--require-tuned", action="store_true",
                    help="fail when a newest record's config lacks "
                         "`tuned: true` — i.e. its knobs did NOT come "
@@ -187,17 +210,29 @@ def parse_cp_gates(items):
                              ("MS", "device:50"))
 
 
+def _rec_flops_per_pair(rec):
+    """A record's flops_per_pair (bench.py/telemetry_summary put it in
+    ``config``, bench_serve.py at the top level); None when absent."""
+    cfg = rec.get("config") or {}
+    v = cfg.get("flops_per_pair", rec.get("flops_per_pair"))
+    return v if isinstance(v, (int, float)) and v > 0 else None
+
+
 def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
           max_quarantined=0, max_ckpt_fallback=0, require_tuned=False,
           max_serve_error_rate=0.0, max_critical_path_ms=None,
-          max_early_exit_epe_delta=None, max_kernel_slowdown=None):
+          max_early_exit_epe_delta=None, max_kernel_slowdown=None,
+          min_mfu=None, max_flops_per_pair_growth=None):
     """``(failures, report)`` over the newest record of each metric."""
     failures, report = [], []
     cp_gates = dict(max_critical_path_ms or {})
     cp_seen = set()
     ker_gates = dict(max_kernel_slowdown or {})
     ker_seen = set()
+    mfu_gates = dict(min_mfu or {})
+    mfu_seen = set()
     ee_seen = False
+    fpp_seen = False
     for metric, recs in sorted(series.items()):
         newest = recs[-1]
         value = newest.get("value")
@@ -281,6 +316,43 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
                                 f"selects it "
                                 f"({k.get('selected_kind')}) — re-run "
                                 "scripts/autotune.py on this device")
+        # Hardware-utilization floor (obs/cost.py MFU): interpret-mode
+        # records and unknown-peak records (CPU — mfu is null there by
+        # design, never a fabricated ratio) are EXCLUDED from
+        # qualifying, so a TPU gate cannot be satisfied by a CPU smoke.
+        mfu = cfg.get("mfu", newest.get("mfu"))
+        if not cfg.get("interpret") and isinstance(mfu, (int, float)):
+            for name, floor in mfu_gates.items():
+                if name not in metric:
+                    continue
+                mfu_seen.add(name)
+                if mfu * 100.0 < floor:
+                    failures.append(
+                        f"{metric}: mfu {mfu * 100.0:.2f}% < floor "
+                        f"{floor:g}% — the chip is underutilized vs "
+                        "the gated baseline (scheduling/fusion "
+                        "regression, or the wrong device peak)")
+        # Work-creep gate: flops_per_pair is hardware- and mesh-
+        # invariant, so growth over the series means the program
+        # genuinely does more work per pair — a throughput 'win' from
+        # shape shrink shows up here as the mirror failure.
+        if max_flops_per_pair_growth is not None:
+            fpp = _rec_flops_per_pair(newest)
+            if fpp is not None:
+                fpp_seen = True
+                prior_fpp = [v for v in map(_rec_flops_per_pair,
+                                            recs[:-1]) if v is not None]
+                if prior_fpp:
+                    ref = statistics.median(
+                        prior_fpp[-max(window, 1):])
+                    growth = (fpp - ref) / ref * 100.0
+                    if growth > max_flops_per_pair_growth:
+                        failures.append(
+                            f"{metric}: flops_per_pair {fpp:g} grew "
+                            f"{growth:.1f}% over the prior-series "
+                            f"median {ref:g} (budget "
+                            f"{max_flops_per_pair_growth:g}%) — work "
+                            "per pair crept up")
         # Early-exit accuracy gate: iterations saved by the convergence
         # cut (docs/SERVING.md) must stay within the EPE budget the
         # sweep measured (evaluate.py --early_exit_threshold).
@@ -342,6 +414,17 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
             f"config.kernels[{name!r}] timings — the microbench "
             "(scripts/bench_kernels.py) did not run on hardware; the "
             "gate cannot pass vacuously")
+    for name in sorted(set(mfu_gates) - mfu_seen):
+        failures.append(
+            f"mfu gate {name!r}: no qualifying record carries an MFU "
+            "figure (interpret-mode and unknown-peak/CPU records are "
+            "excluded) — the cost-instrumented bench did not run on "
+            "known hardware; the gate cannot pass vacuously")
+    if max_flops_per_pair_growth is not None and not fpp_seen:
+        failures.append(
+            "flops-per-pair gate: no record carries flops_per_pair "
+            "(config or top-level) — the cost accounting did not run; "
+            "the gate cannot pass vacuously")
     if max_early_exit_epe_delta is not None and not ee_seen:
         failures.append(
             "early-exit gate: no record carries "
@@ -385,13 +468,15 @@ def _selftest() -> int:
     file-loading path."""
 
     def run(values, nonfinite_last=0, drop_pct=10.0, last_cfg=None,
-            last_top=None, **gate_kw):
+            last_top=None, cfgs=None, **gate_kw):
         with tempfile.TemporaryDirectory() as td:
             paths = []
             for i, v in enumerate(values):
                 rec = {"metric": "train_throughput_tiny", "value": v,
                        "unit": "image-pairs/sec/chip", "vs_baseline": 0.0,
                        "config": {}}
+                if cfgs is not None:
+                    rec["config"].update(cfgs[i])
                 if i == len(values) - 1:
                     if nonfinite_last:
                         rec["config"]["nonfinite_steps_total"] = \
@@ -517,6 +602,43 @@ def _selftest() -> int:
              last_cfg={"kernels": {"gru": {
                  "fused_ms": 99.0, "unfused_ms": 10.0,
                  "selected": True}}}), False),
+        ("mfu above floor passes",
+         run([30.0, 31.0, 30.5], last_cfg={"mfu": 0.45},
+             min_mfu={"train_throughput": 40.0}), False),
+        ("mfu below floor fails",
+         run([30.0, 31.0, 30.5], last_cfg={"mfu": 0.25},
+             min_mfu={"train_throughput": 40.0}), True),
+        ("mfu gate without record fails",
+         run([30.0, 31.0, 30.5],
+             min_mfu={"train_throughput": 40.0}), True),
+        ("interpret record never satisfies the mfu gate",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"interpret": True, "mfu": 0.45},
+             min_mfu={"train_throughput": 40.0}), True),
+        ("null mfu (CPU peak) never satisfies the mfu gate",
+         run([30.0, 31.0, 30.5], last_cfg={"mfu": None},
+             min_mfu={"train_throughput": 40.0}), True),
+        ("low mfu without the gate passes",
+         run([30.0, 31.0, 30.5], last_cfg={"mfu": 0.02}), False),
+        ("flat flops_per_pair passes",
+         run([30.0, 31.0, 30.5],
+             cfgs=[{"flops_per_pair": 1e9}] * 3,
+             max_flops_per_pair_growth=5.0), False),
+        ("flops_per_pair growth over budget fails",
+         run([30.0, 31.0, 30.5],
+             cfgs=[{"flops_per_pair": 1e9}, {"flops_per_pair": 1e9},
+                   {"flops_per_pair": 1.2e9}],
+             max_flops_per_pair_growth=5.0), True),
+        ("first costed record passes the growth gate",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"flops_per_pair": 1e9},
+             max_flops_per_pair_growth=5.0), False),
+        ("flops_per_pair gate without data fails",
+         run([30.0, 31.0, 30.5], max_flops_per_pair_growth=5.0), True),
+        ("flops_per_pair growth without the gate passes",
+         run([30.0, 31.0, 30.5],
+             cfgs=[{"flops_per_pair": 1e9}, {"flops_per_pair": 1e9},
+                   {"flops_per_pair": 9e9}]), False),
     ]
 
     def run_lint(payload):
@@ -582,7 +704,12 @@ def main(argv=None):
                              max_kernel_slowdown=parse_named_gates(
                                  args.max_kernel_slowdown,
                                  "--max-kernel-slowdown",
-                                 ("PCT", "gru:5")))
+                                 ("PCT", "gru:5")),
+                             min_mfu=parse_named_gates(
+                                 args.min_mfu, "--min-mfu",
+                                 ("PCT", "train_throughput:40")),
+                             max_flops_per_pair_growth=(
+                                 args.max_flops_per_pair_growth))
     if args.lint_report:
         failures.extend(lint_gate(args.lint_report))
     print(json.dumps({"ok": not failures, "failures": failures,

@@ -135,8 +135,11 @@ def main(argv=None) -> int:
 
     env_backup = {k: os.environ.get(k)
                   for k in ("RAFT_TELEMETRY_DIR", "RAFT_TELEMETRY_HBM",
-                            "RAFT_CHAOS_SPEC")}
-    os.environ["RAFT_TELEMETRY_HBM"] = "0"  # skip the extra startup compile
+                            "RAFT_TELEMETRY_COST", "RAFT_CHAOS_SPEC")}
+    # hbm + cost share one extra startup lower().compile() per train()
+    # — this smoke re-enters the loop ~6 times, skip it.
+    os.environ["RAFT_TELEMETRY_HBM"] = "0"
+    os.environ["RAFT_TELEMETRY_COST"] = "0"
     os.environ.pop("RAFT_CHAOS_SPEC", None)  # plans installed directly
 
     from raft_tpu import chaos

@@ -20,6 +20,14 @@ recorded during the capture carries ``xprof=<outdir>``:
     hlo_stats.json      per-op table (category, self time, FLOP rate)
     op_profile.json     xprof op_profile tree
     summary.txt         top self-time ops + per-category rollup
+
+Before profiling, ask what the step is *bound by*: ``python -m
+raft_tpu cost`` prints the compiled programs' FLOPs/bytes/roofline
+verdict from compile-time metadata alone (``raft_tpu/obs/cost.py``;
+``docs/PERFORMANCE.md`` has the triage table) — a memory-bound
+verdict changes what to look for in the capture, and the measured
+FLOP rates here are what validate the cost model's analytic kernel
+formulas on hardware (``scripts/tpu_backlog_r07.sh``).
 """
 
 from __future__ import annotations

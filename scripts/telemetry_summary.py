@@ -67,31 +67,42 @@ def iter_records(path):
 
 def last_run(records):
     """``(run_config, [train_step...], [train_health...], faults,
-    [trace_span...])`` of the LAST run in the log (files append across
-    runs; run_config marks each start).  Logs from builds without
-    training-health or tracing telemetry simply yield empty lists.
+    [trace_span...], [cost_report...])`` of the LAST run in the log
+    (files append across runs; run_config marks each start).  Logs from
+    builds without training-health, tracing, or cost-model telemetry
+    simply yield empty lists.
 
     ``faults`` counts the fault-tolerance events (docs/ROBUSTNESS.md)
     over the WHOLE log, not just the last run: resume fallback fires
     BEFORE the resumed run's run_config is written, and a quarantined
     sample is data rot regardless of which restart hit it — the
     check_regression gate wants the conservative total."""
-    run_cfg, steps, health, spans = None, [], [], []
+    run_cfg, steps, health, spans, costs = None, [], [], [], []
     faults = {"sample_quarantine": 0, "ckpt_fallback": 0,
               "serve_retry": 0, "chaos_inject": 0}
     for rec in records:
         ev = rec.get("event")
         if ev == "run_config":
-            run_cfg, steps, health, spans = rec, [], [], []
+            run_cfg, steps, health, spans, costs = rec, [], [], [], []
         elif ev == "train_step":
             steps.append(rec)
         elif ev == "train_health":
             health.append(rec)
         elif ev == "trace_span":
             spans.append(rec)
+        elif ev == "cost_report":
+            costs.append(rec)
+        elif ev == "metrics_summary":
+            # The run's final raft_cost_mfu gauge values ride along as
+            # a synthetic record so summarize() folds them next to the
+            # compile-time cost_report stream.
+            vals = rec.get("metrics", {}).get("raft_cost_mfu",
+                                              {}).get("values")
+            if vals:
+                costs.append({"_mfu_gauge": vals})
         elif ev in faults:
             faults[ev] += 1
-    return run_cfg, steps, health, faults, spans
+    return run_cfg, steps, health, faults, spans, costs
 
 
 def _wait_s(rec):
@@ -135,8 +146,43 @@ def trace_summary(spans):
     return out
 
 
+def cost_summary(costs, value):
+    """Fold the run's ``cost_report`` events (obs/cost.py, one per
+    captured program) + final ``raft_cost_mfu`` gauge values into
+    config-block fields.  ``value`` is the measured pairs/sec/chip —
+    multiplying it back through the compiled step's ``flops_per_pair``
+    is what turns the throughput into ``achieved_tflops``/``mfu``
+    (None when the device peak is unknown, e.g. CPU).  ``{}`` for logs
+    without cost telemetry — old logs summarize unchanged."""
+    if not costs:
+        return {}
+    out = {}
+    by_prog = {}
+    for c in costs:
+        if "_mfu_gauge" in c:
+            out["mfu_gauge"] = c["_mfu_gauge"]
+        elif c.get("program"):
+            by_prog[c["program"]] = c  # last capture wins
+    if by_prog:
+        out["cost"] = {
+            prog: {k: c.get(k) for k in
+                   ("flops", "bytes", "flops_per_pair",
+                    "arithmetic_intensity", "bound_by", "source")}
+            for prog, c in sorted(by_prog.items())}
+    tc = by_prog.get("train_step")
+    if tc and tc.get("flops_per_pair"):
+        fpp = tc["flops_per_pair"]
+        achieved = value * fpp / 1e12
+        peak = tc.get("peak_tflops")
+        out["flops_per_pair"] = fpp
+        out["achieved_tflops"] = round(achieved, 4)
+        out["mfu"] = round(achieved / peak, 4) if peak else None
+        out["bound_by"] = tc.get("bound_by")
+    return out
+
+
 def summarize(run_cfg, steps, health=None, faults=None, spans=None,
-              skip=2):
+              costs=None, skip=2):
     if run_cfg is None:
         raise SystemExit("no run_config event in log (telemetry written "
                          "by an older build?) — cannot recover batch "
@@ -186,6 +232,8 @@ def summarize(run_cfg, steps, health=None, faults=None, spans=None,
     # tracing"): per-span-name duration percentiles + how many traces
     # completed and what fraction erred.  Absent without trace events.
     health_cfg.update(trace_summary(spans))
+    # Cost-model fold (docs/OBSERVABILITY.md "Cost model & roofline").
+    health_cfg.update(cost_summary(costs, value))
     last_health = (health or [None])[-1]
     if last_health is not None:
         health_cfg["nonfinite_steps_total"] = last_health.get(
@@ -220,10 +268,10 @@ def summarize(run_cfg, steps, health=None, faults=None, spans=None,
 
 def main(argv=None):
     args = parse_args(argv)
-    run_cfg, steps, health, faults, spans = last_run(
+    run_cfg, steps, health, faults, spans, costs = last_run(
         iter_records(args.path))
     print(json.dumps(summarize(run_cfg, steps, health, faults, spans,
-                               skip=args.skip)))
+                               costs, skip=args.skip)))
 
 
 if __name__ == "__main__":

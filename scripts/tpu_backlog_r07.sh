@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-7 TPU backlog, priority order: validate the cost model
+# (raft_tpu/obs/cost.py) on hardware and arm the hardware-normalized
+# gates.  Off-TPU the model is parity-pinned against interpret-mode
+# XLA counts only; this round checks the analytic kernel formulas
+# against XProf-measured FLOP rates, records the first real MFU
+# baselines, and turns on --min-mfu / --max-flops-per-pair-growth for
+# the BENCH series.  Every step is independently resumable.
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+
+# 0. The cost table itself on real hardware: device_kind must resolve
+#    to a known peak (v5e/v4 -> nonzero MFU downstream; an "unknown"
+#    kind here means PEAK_SPECS needs the new part's datasheet row
+#    BEFORE any gate below is armed)
+python -m raft_tpu cost --json | tee COST_r07.json | python -m json.tool | head -30
+
+# 1. Analytic-formula validation: on TPU the fused kernels are opaque
+#    custom_calls (cost_source=analytic in the fused arms), and
+#    profile_step's hlo_stats carry XProf's *measured* flop rates for
+#    the same ops.  Compare analytic_flops vs the custom-call rows in
+#    hlo_stats.json — agreement within ~2x validates the formulas;
+#    worse means a block-spec drift (fix obs/cost.py, not the gate).
+python scripts/bench_kernels.py --image 368x496 --batch 16 \
+    2>&1 | tee /tmp/bench_kernels_r07.log | tail -1 \
+    > BENCH_KERNELS_r07.json
+python scripts/profile_step.py /tmp/xprof_r07 2>&1 | tail -20
+
+# 2. Headline benches with cost fields: train + eval + serve records
+#    now carry flops_per_pair / achieved_tflops / mfu / bound_by —
+#    these are the first hardware MFU numbers in the BENCH series
+python bench.py 2>&1 | tee /tmp/bench_r07.log | tail -2 > BENCH_r07.json
+BENCH_MODE=eval python bench.py 2>&1 | tee /tmp/bench_eval_r07.log \
+    | tail -2 > BENCH_EVAL_r07.json
+python scripts/bench_serve.py --batching both --shapes 440x1024 \
+    --requests 128 --concurrency 16 \
+    2>&1 | tee /tmp/bench_serve_r07.log | tail -1 > BENCH_SERVE_r07.json
+
+# 3. Arm the hardware-normalized gates against the fresh records.
+#    Floors are INTENTIONALLY soft on first arming (half of whatever
+#    step 2 measured, rounded down): the point this round is that the
+#    gates hold real data, not that the chip is already well fed —
+#    ratchet the PCT once the MFU trend is understood.  Both gates
+#    fail vacuously without qualifying records, so a wrong backend or
+#    a cost-silent record shows up here, not in a false pass.
+python scripts/check_regression.py \
+    --min-mfu train_throughput:10 --min-mfu eval_forward:5 \
+    --max-flops-per-pair-growth 5 2>&1 | tail -3
+
+# 4. Traced serve run + the roofline fold: spans carry flops/mfu attrs
+#    on hardware, so the cost-weighted critical path separates kernel
+#    time from host/queueing time per request
+RAFT_TRACE_SAMPLE_RATE=0.1 RAFT_TELEMETRY_DIR=/tmp/telem_r07 \
+    python scripts/bench_serve.py --batching slot --shapes 440x1024 \
+    --requests 64 --concurrency 8 2>&1 | tail -1
+python scripts/trace_report.py /tmp/telem_r07 --roofline 2>&1 | tail -20

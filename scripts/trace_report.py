@@ -63,6 +63,17 @@ def parse_args(argv=None):
                    help="print one bench.py-format JSON line "
                         "(critical_path_ms + serve_span_names in the "
                         "config block) instead of waterfalls")
+    p.add_argument("--roofline", action="store_true",
+                   help="per-span-name roofline table over the spans "
+                        "that carry cost attrs (flops/bytes/mfu, "
+                        "attached by the serve engine from "
+                        "obs/cost.py), plus the cost-weighted "
+                        "critical path")
+    p.add_argument("--device-kind", default=None, metavar="KIND",
+                   help="classify --roofline against this device's "
+                        "peak specs (e.g. 'v5e') instead of the "
+                        "current backend's — for reading a "
+                        "TPU-captured trace on a laptop")
     p.add_argument("--tiny", action="store_true",
                    help="selftest: synthesize a hedged trace through "
                         "the real tracer, then report on it")
@@ -307,6 +318,107 @@ def bench_record(traces):
 
 
 # ---------------------------------------------------------------------------
+# roofline + cost-weighted critical path (--roofline)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_spec(device_kind=None):
+    """Peak specs for the roofline verdicts.  An explicit
+    ``--device-kind`` wins (classify a TPU trace offline); otherwise
+    the current backend's — degrading to unknown peaks (not an error)
+    when no backend is importable, since this is a log-reading tool."""
+    from raft_tpu.obs import cost as cost_mod
+
+    try:
+        return cost_mod.peak_spec(device_kind)
+    except Exception:
+        return cost_mod.PeakSpec(str(device_kind or "unknown"),
+                                 None, None)
+
+
+def _span_flops(rec):
+    v = rec.get("flops")
+    return float(v) if isinstance(v, (int, float)) and v > 0 else None
+
+
+def roofline_report(traces, spec, out=sys.stdout):
+    """Two tables off the cost attrs the engine attaches to its spans
+    (``flops``/``bytes`` from the compile-time ledger, ``mfu`` from the
+    observed call time — obs/cost.py):
+
+    - per span name: total work, arithmetic intensity and the
+      compute/memory verdict against ``spec``'s ridge point, plus the
+      observed MFU spread (``-`` throughout on unknown peaks / CPU);
+    - the **cost-weighted critical path**: of the FLOPs executed on
+      the latency-bounding chains, which span name runs them, at what
+      p95 self-time — a span owning most on-path FLOPs at low MFU is
+      the optimization target; one owning milliseconds but no FLOPs
+      is queueing/host overhead no kernel work will fix."""
+    per = {}
+    for t in traces.values():
+        for rec in t["spans"].values():
+            fl = _span_flops(rec)
+            if fl is None:
+                continue
+            row = per.setdefault(rec["name"],
+                                 {"n": 0, "flops": 0.0, "bytes": 0.0,
+                                  "mfu": []})
+            row["n"] += 1
+            row["flops"] += fl
+            row["bytes"] += float(rec.get("bytes", 0.0) or 0.0)
+            if isinstance(rec.get("mfu"), (int, float)):
+                row["mfu"].append(float(rec["mfu"]))
+    ridge = spec.ridge
+    print(f"roofline vs {spec.kind}: "
+          f"peak {spec.tflops if spec.tflops else '-'} bf16 TFLOP/s, "
+          f"{spec.hbm_gbps if spec.hbm_gbps else '-'} GB/s, "
+          f"ridge {f'{ridge:.1f}' if ridge else '-'} flop/byte",
+          file=out)
+    if not per:
+        print("  (no spans carry cost attrs — trace predates the cost "
+              "model, or the engine ran with RAFT_TELEMETRY_COST=0)",
+              file=out)
+        return
+    hdr = (f"  {'span':<16} {'n':>4} {'GFLOPs':>10} {'MB':>10} "
+           f"{'flop/byte':>10} {'bound_by':>9} {'mfu_p50':>8} "
+           f"{'mfu_max':>8}")
+    print(hdr, file=out)
+    print("  " + "-" * (len(hdr) - 2), file=out)
+    for name, row in sorted(per.items(), key=lambda kv: -kv[1]["flops"]):
+        ai = row["flops"] / row["bytes"] if row["bytes"] > 0 else None
+        bound = ("unknown" if ai is None or ridge is None
+                 else "compute" if ai >= ridge else "memory")
+        mfu = sorted(row["mfu"])
+        p50 = f"{mfu[len(mfu) // 2]:.4f}" if mfu else "-"
+        mx = f"{mfu[-1]:.4f}" if mfu else "-"
+        print(f"  {name:<16} {row['n']:>4} "
+              f"{row['flops'] / 1e9:>10.3f} {row['bytes'] / 1e6:>10.3f} "
+              f"{f'{ai:.3f}' if ai is not None else '-':>10} "
+              f"{bound:>9} {p50:>8} {mx:>8}", file=out)
+
+    on_path = {}
+    total_flops = 0.0
+    for t in traces.values():
+        for n, ms in critical_path(t):
+            row = on_path.setdefault(n["name"],
+                                     {"flops": 0.0, "ms": []})
+            row["ms"].append(ms)
+            fl = _span_flops(n)
+            if fl is not None:
+                row["flops"] += fl
+                total_flops += fl
+    print("  cost-weighted critical path "
+          "(share of on-path FLOPs, p95 self-time):", file=out)
+    for name, row in sorted(on_path.items(),
+                            key=lambda kv: (-kv[1]["flops"],
+                                            -max(kv[1]["ms"]))):
+        share = (f"{row['flops'] / total_flops * 100.0:5.1f}%"
+                 if total_flops > 0 and row["flops"] > 0 else "    -")
+        print(f"    {name:<16} {share}  {_p95(row['ms']):9.3f}ms "
+              f"x{len(row['ms'])}", file=out)
+
+
+# ---------------------------------------------------------------------------
 # --tiny selftest
 # ---------------------------------------------------------------------------
 
@@ -330,12 +442,14 @@ def _synthesize(directory):
     t0 = time.perf_counter()
     a = root.child("attempt", replica="r0", hedge=False)
     record_span(a, "queue", t0, t0 + 0.020)
-    record_span(a, "device", t0 + 0.020, t0 + 0.100, retries=0)
+    record_span(a, "device", t0 + 0.020, t0 + 0.100, retries=0,
+                flops=2.0e9, bytes=1.0e9, mfu=0.18)
     time.sleep(0.040)
     b = root.child("attempt", replica="r1", hedge=True)
     record_span(b, "queue", t0 + 0.040, t0 + 0.042)
     record_span(b, "pad", t0 + 0.042, t0 + 0.043, real=1, ballast=1)
-    record_span(b, "device", t0 + 0.043, t0 + 0.055, retries=0)
+    record_span(b, "device", t0 + 0.043, t0 + 0.055, retries=0,
+                flops=2.0e9, bytes=1.0e9, mfu=0.31)
     time.sleep(0.020)               # past b's device end
     b.end(status="ok", won=True)
     root.mark_keep()                # the hedge fired: tail-keep
@@ -379,6 +493,22 @@ def _selftest():
         pf = perfetto_events(traces)
         json.loads(json.dumps(pf))  # exports as valid JSON
         assert any(e.get("ph") == "X" for e in pf["traceEvents"])
+        # Roofline over the cost attrs the device spans carried, under
+        # a KNOWN peak (v5e) so bound-by classifies and MFU folds.
+        import io
+
+        from raft_tpu.obs import cost as cost_mod
+
+        buf = io.StringIO()
+        roofline_report(traces, cost_mod.peak_spec("v5e"), out=buf)
+        txt = buf.getvalue()
+        print(txt, end="")
+        assert "device" in txt and "memory" in txt, \
+            f"2 flop/byte vs the v5e ridge must read memory-bound:\n{txt}"
+        assert "0.31" in txt, f"max observed mfu must surface:\n{txt}"
+        assert "cost-weighted critical path" in txt
+        # The bench record stays the LAST stdout line (tests and the
+        # backlog scripts tail it into check_regression).
         rec = bench_record(traces)
         assert rec["config"]["traces_total"] == 2
         assert {"queue", "pad", "device"} <= set(
@@ -411,6 +541,9 @@ def main(argv=None):
             return 0
     if args.json:
         print(json.dumps(bench_record(traces)))
+        return 0
+    if args.roofline:
+        roofline_report(traces, _resolve_spec(args.device_kind))
         return 0
     if args.trace:
         matches = [t for tid, t in traces.items()

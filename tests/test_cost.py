@@ -1,0 +1,429 @@
+"""Cost-model observability contracts (raft_tpu/obs/cost.py, tier-1).
+
+Pinned here:
+
+- **Extraction round-trip**: ``program_cost`` off a tiny jitted matmul
+  reports XLA's exact flop count through both call forms (jitted fn +
+  args, and an already-compiled executable), and ``as_record`` carries
+  every field the ``cost_report`` event / ``raft_tpu cost`` table need.
+- **Zero device sync**: capture consumes ONLY ``cost_analysis()`` —
+  proven with a duck-typed stub exposing nothing else — and degrades
+  (broken/empty analysis -> analytic fallback -> ``unavailable``)
+  without ever raising.
+- **Roofline math**: peak-spec normalization (libtpu's two v5e
+  spellings), compute/memory classification against the ridge point,
+  and the "no fabricated ratios" rule — CPU / interpret-mode MFU is
+  ``None``, which is what keeps those records out of
+  ``check_regression --min-mfu`` (gate semantics asserted here too).
+- **Analytic parity**: the hand-derived Pallas kernel formulas land
+  within a loose band of XLA's own count of the interpret-lowered
+  kernel body — the sanity pin for what real-TPU custom_call arms
+  report (exact agreement is NOT expected: XLA counts the lowered HLO
+  of the emulation, the formulas count the kernel's block math).
+- **Slot-ledger stamping**: the serve engine stamps both compiled slot
+  programs under its compile-ledger keys, emits one ``cost_report``
+  each, and surfaces them in ``stats()["cost"]``.
+- **CLI smoke**: ``python -m raft_tpu cost --tiny`` covers train step,
+  inference, and both serve programs with nonzero flops/bytes.
+
+Small model, fp32, tiny shapes.  Anything that compiles a real program
+graph — the interpret-Pallas parity pins, the slot-ledger engine
+drive, and the four-compile CLI smoke — is slow-tier (the tier-1 suite
+runs against a hard wall-clock budget, ROADMAP.md); the
+extraction/roofline/gate units and the compile-free CLI envelope
+contract are tier-1.
+"""
+
+import importlib.util
+import json
+import os.path as osp
+
+import numpy as np
+import pytest
+
+from raft_tpu.obs import cost as cost_mod
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, osp.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+
+def test_extraction_roundtrip_matmul():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(a, b):
+        return a @ b
+
+    a = jnp.zeros((4, 64, 128), jnp.float32)
+    b = jnp.zeros((4, 128, 32), jnp.float32)
+    cost = cost_mod.program_cost(f, a, b, program="toy_matmul",
+                                 pairs_per_call=4)
+    assert cost.source == "xla"
+    assert cost.flops == pytest.approx(2 * 4 * 64 * 128 * 32)
+    assert cost.bytes > 0
+    assert cost.flops_per_pair == pytest.approx(cost.flops / 4)
+
+    # the already-compiled form (the serve ledger path) sees the same
+    # numbers — it is the same executable metadata
+    compiled = f.lower(a, b).compile()
+    again = cost_mod.program_cost(compiled, program="toy_matmul")
+    assert again.flops == cost.flops and again.bytes == cost.bytes
+
+    rec = cost.as_record(seconds=0.01)
+    for key in ("program", "flops", "bytes", "source", "device_kind",
+                "interpret", "peak_tflops", "arithmetic_intensity",
+                "bound_by", "flops_per_pair", "seconds",
+                "achieved_tflops", "mfu", "hbm_bw_util"):
+        assert key in rec, key
+    json.dumps(rec)  # event-payload shape: JSON-clean
+    assert rec["achieved_tflops"] == round(cost.flops / 0.01 / 1e12, 4)
+
+
+def test_capture_is_host_metadata_only():
+    """The zero-device-sync contract: capture touches nothing but
+    ``cost_analysis()`` — a stub exposing ONLY that method (no
+    ``__call__``, no buffers, no device) is a fully valid source."""
+
+    class _Compiled:
+        def cost_analysis(self):
+            return [{"flops": 42.0, "bytes accessed": 7.0,
+                     "transcendentals": 1.0}]
+
+    cost = cost_mod.program_cost(_Compiled(), program="stub",
+                                 device_kind="cpu")
+    assert (cost.flops, cost.bytes, cost.transcendentals) == (42.0, 7.0,
+                                                              1.0)
+    assert cost.source == "xla"
+
+    class _Broken:
+        def cost_analysis(self):
+            raise RuntimeError("backend reports nothing")
+
+    assert cost_mod.program_cost(_Broken(), program="stub",
+                                 device_kind="cpu").source == \
+        "unavailable"
+    fb = cost_mod.program_cost(_Broken(), program="stub",
+                               device_kind="cpu", analytic=(5.0, 2.0))
+    assert fb.source == "analytic"
+    assert (fb.flops, fb.bytes) == (5.0, 2.0)
+
+    class _Empty:
+        def cost_analysis(self):
+            return []  # some jaxlibs: empty for custom-call-only
+
+    assert cost_mod.program_cost(_Empty(), program="stub",
+                                 device_kind="cpu",
+                                 analytic=(3.0, 1.0)).source == \
+        "analytic"
+
+
+# ---------------------------------------------------------------------------
+# peak specs + roofline math
+# ---------------------------------------------------------------------------
+
+
+def test_peak_spec_normalization():
+    assert cost_mod.peak_spec("TPU v5e").tflops == 197.0
+    assert cost_mod.peak_spec("TPU v5 lite").kind == "v5e"
+    assert cost_mod.peak_spec("tpu v5lite podslice").kind == "v5e"
+    assert cost_mod.peak_spec("TPU v4").tflops == 275.0
+    cpu = cost_mod.peak_spec("cpu")
+    assert cpu.tflops is None and cpu.ridge is None
+    # an UNKNOWN kind degrades to unknown peaks, never a wrong spec
+    weird = cost_mod.peak_spec("npu-9000")
+    assert weird.tflops is None and weird.hbm_gbps is None
+    ridge = cost_mod.peak_spec("v5e").ridge
+    assert ridge == pytest.approx(197.0e12 / 819.0e9)
+
+
+def _cost(flops, byts, **kw):
+    return cost_mod.ProgramCost(program="p", flops=flops, bytes=byts,
+                                **kw)
+
+
+def test_roofline_classification_and_mfu():
+    ridge = cost_mod.peak_spec("v5e").ridge
+    hi = _cost(1e12, 1e9, device_kind="v5e")      # 1000 flop/byte
+    lo = _cost(1e9, 1e9, device_kind="v5e")       # 1 flop/byte
+    assert hi.arithmetic_intensity > ridge and hi.bound_by == "compute"
+    assert lo.arithmetic_intensity < ridge and lo.bound_by == "memory"
+    assert hi.mfu(1.0) == pytest.approx(1.0 / 197.0)
+    assert lo.hbm_bw_util(1.0) == pytest.approx(1.0 / 819.0)
+    # no fabricated ratios: unknown peak (CPU) and interpret-mode wall
+    # time both yield None, never a number
+    assert _cost(1e12, 1e9, device_kind="cpu").mfu(1.0) is None
+    assert _cost(1e12, 1e9, device_kind="cpu").bound_by == "unknown"
+    assert _cost(1e12, 1e9, device_kind="v5e",
+                 interpret=True).mfu(1.0) is None
+    assert _cost(1e12, 0.0, device_kind="v5e").bound_by == "unknown"
+    assert _cost(1e12, 1e9, device_kind="v5e").mfu(0.0) is None
+
+
+def test_min_mfu_gate_excludes_interpret_and_unknown_peak():
+    """The check_regression semantics the None-MFU rule exists for: a
+    CPU (mfu null) or interpret record can never satisfy --min-mfu,
+    and the gate fails rather than passing vacuously."""
+    cr = _load_script("check_regression")
+
+    def rec(cfg):
+        return {"metric": "train_throughput_tiny", "value": 30.0,
+                "config": cfg}
+
+    gate = {"min_mfu": {"train_throughput": 40.0}}
+    ok, _ = cr.check({"train_throughput_tiny": [rec({"mfu": 0.45})]},
+                     **gate)
+    assert not ok
+    low, _ = cr.check({"train_throughput_tiny": [rec({"mfu": 0.25})]},
+                      **gate)
+    assert any("mfu" in f for f in low)
+    for excluded in ({"mfu": None}, {"mfu": 0.45, "interpret": True},
+                     {}):
+        failures, _ = cr.check(
+            {"train_throughput_tiny": [rec(excluded)]}, **gate)
+        assert any("vacuously" in f for f in failures), excluded
+
+
+# ---------------------------------------------------------------------------
+# analytic parity (interpret mode lowers the kernels to countable HLO)
+# ---------------------------------------------------------------------------
+
+#: The formulas count the kernel's block math; XLA counts the lowered
+#: HLO of the interpreter emulation — measured ~12% apart at the bench
+#: --tiny shape.  The band is deliberately loose: it catches a dropped
+#: term or a wrong padding rule (order-of-magnitude errors), not
+#: accounting-convention drift.
+PARITY_BAND = (0.3, 3.0)
+
+
+@pytest.mark.slow
+def test_analytic_gru_blend_parity():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.pallas_gru import gru_gate_blend
+
+    shape = (1, 8, 16, 96)
+    z = jnp.zeros(shape, jnp.float32)
+
+    @jax.jit
+    def fused(z, q, h):
+        return gru_gate_blend(z, q, h, interpret=True)
+
+    got = cost_mod.xla_cost(fused.lower(z, z, z).compile())
+    assert got is not None and got["flops"] > 0
+    flops, byts = cost_mod.analytic_gru_gate_cost(shape, kind="blend")
+    assert PARITY_BAND[0] < flops / got["flops"] < PARITY_BAND[1], \
+        (flops, got["flops"])
+    assert byts > 0
+    # rh is the smaller chain; same padded-element base
+    rh_flops, _ = cost_mod.analytic_gru_gate_cost(shape, kind="rh")
+    assert rh_flops < flops
+    with pytest.raises(ValueError):
+        cost_mod.analytic_gru_gate_cost(shape, kind="nope")
+
+
+@pytest.mark.slow
+def test_analytic_lookup_encode_parity():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.corr import build_corr_pyramid_flat
+    from raft_tpu.ops.pallas_corr import pallas_pyramid_lookup_encode
+    from raft_tpu.ops.sampler import coords_grid
+
+    # the bench --tiny shape: 1/8 res 8x16 = one 128-query block
+    B, h8, w8, L, r, F = 1, 8, 16, 4, 3, 96
+    kk = L * (2 * r + 1) ** 2
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    f1 = jax.random.normal(keys[0], (B, h8, w8, 256), jnp.float32)
+    f2 = jax.random.normal(keys[1], (B, h8, w8, 256), jnp.float32)
+    pyr = build_corr_pyramid_flat(f1, f2, L)
+    coords = coords_grid(B, h8, w8)
+    w = jax.random.normal(keys[3], (kk, F), jnp.float32) * kk ** -0.5
+    b = jnp.zeros((F,), jnp.float32)
+
+    @jax.jit
+    def fused(coords, w, b):
+        return pallas_pyramid_lookup_encode(pyr, coords, w, b, r, 128,
+                                            True)
+
+    got = cost_mod.xla_cost(fused.lower(coords, w, b).compile())
+    assert got is not None and got["flops"] > 0
+    level_hw = [(max(h8 >> lv, 1), max(w8 >> lv, 1)) for lv in range(L)]
+    flops, byts = cost_mod.analytic_lookup_encode_cost(
+        B, level_hw, h8 * w8, r, F)
+    assert PARITY_BAND[0] < flops / got["flops"] < PARITY_BAND[1], \
+        (flops, got["flops"])
+    assert byts > 0
+    # int8 pyramids stream 4x fewer pyramid bytes
+    _, byts_q = cost_mod.analytic_lookup_encode_cost(
+        B, level_hw, h8 * w8, r, F, pyramid_bytes=1)
+    assert byts_q < byts
+
+
+# ---------------------------------------------------------------------------
+# cost book + serve slot-ledger stamping
+# ---------------------------------------------------------------------------
+
+
+class _RecordingSink:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, step=None, **fields):
+        self.events.append((event, fields))
+
+    def of(self, event):
+        return [f for e, f in self.events if e == event]
+
+
+def test_cost_book_stamp_observe_and_emission():
+    from raft_tpu.obs.registry import MetricRegistry
+
+    sink = _RecordingSink()
+    reg = MetricRegistry()
+    book = cost_mod.CostBook(registry=reg, sink=sink)
+    c = _cost(2.0e12, 1.0e9, device_kind="v5e", pairs_per_call=4)
+    book.stamp("p", c)
+    (ev,) = sink.of("cost_report")
+    assert ev["flops"] == 2.0e12 and ev["bound_by"] == "compute"
+    attrs = book.observe("p", 0.1)        # 20 achieved TFLOP/s
+    assert attrs["flops"] == 2.0e12
+    assert attrs["mfu"] == pytest.approx(20.0 / 197.0, abs=1e-4)
+    # observe refreshes gauges, never re-emits the capture event
+    assert len(sink.of("cost_report")) == 1
+    dump = reg.render_prometheus()
+    assert "raft_cost_mfu" in dump and "raft_cost_flops_per_pair" in dump
+    assert book.observe("missing", 0.1) == {}
+    # telemetry never fails the workload: a sink that throws is eaten
+    class _Boom:
+        def emit(self, *a, **k):
+            raise RuntimeError("sink down")
+
+    cost_mod.CostBook(sink=_Boom()).stamp("p", c)
+
+
+@pytest.mark.slow
+def test_serve_slot_ledger_stamping(serve_variables):
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.serve import InferenceEngine, ServeConfig
+
+    sink = _RecordingSink()
+    eng = InferenceEngine(serve_variables, RAFTConfig.small_model(),
+                          ServeConfig(iters=2, batching="slot",
+                                      slots=2), sink=sink)
+    rng = np.random.default_rng(3)
+    a = rng.uniform(0, 255, (36, 52, 3)).astype(np.float32)
+    with eng:
+        eng.submit(a, a).result(timeout=120)
+        stats = eng.stats()
+    table = eng.cost_book.table()
+    assert set(table) == {((40, 56), 2, "enc"), ((40, 56), 2, "iter")}
+    for c in table.values():
+        assert c.flops > 0 and c.bytes > 0 and c.source == "xla"
+        assert c.flops_per_pair == pytest.approx(c.flops / 2)
+    # stats() mirrors the ledger under flat string keys ...
+    assert set(stats["cost"]) == {"40x56/b2/enc", "40x56/b2/iter"}
+    assert stats["cost"]["40x56/b2/iter"]["flops"] > 0
+    # ... and each program emitted exactly one cost_report at stamp
+    progs = sorted(ev["program"] for ev in sink.of("cost_report"))
+    assert progs == ["serve_enc_40x56_b2", "serve_iter_40x56_b2"]
+
+
+@pytest.fixture(scope="module")
+def serve_variables():
+    import jax
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    img = jax.numpy.zeros((1, 40, 56, 3))
+    rng = jax.random.PRNGKey(0)
+    return RAFT(RAFTConfig.small_model()).init(
+        {"params": rng, "dropout": rng}, img, img, iters=1)
+
+
+# ---------------------------------------------------------------------------
+# CLI smoke (the acceptance drill: python -m raft_tpu cost --tiny)
+# ---------------------------------------------------------------------------
+
+
+def test_cost_cli_envelope(capsys, monkeypatch):
+    """Tier-1 CLI contract, compile-free: argument plumbing, the JSON
+    envelope, and the human table are pinned against canned costs —
+    the real four-program compile drive is the slow-tier smoke below."""
+    from raft_tpu.cli import cost as cli
+
+    canned = [
+        cost_mod.ProgramCost(program="train_step", flops=4.0e9,
+                             bytes=2.0e9, pairs_per_call=2),
+        cost_mod.ProgramCost(program="inference_48x64", flops=1.0e9,
+                             bytes=5.0e8, pairs_per_call=1),
+        cost_mod.ProgramCost(program="serve_enc_40x56_b2", flops=6.0e8,
+                             bytes=3.0e8, pairs_per_call=2),
+        cost_mod.ProgramCost(program="serve_iter_40x56_b2", flops=2.0e8,
+                             bytes=1.0e8, pairs_per_call=2),
+    ]
+    seen = {}
+
+    def fake_collect(model_cfg, train_hw, batch, iters, bucket, lanes,
+                     num_data=None):
+        seen.update(train_hw=train_hw, batch=batch, iters=iters,
+                    bucket=bucket, lanes=lanes, num_data=num_data)
+        return canned
+
+    monkeypatch.setattr(cli, "collect_costs", fake_collect)
+    assert cli.main(["--tiny", "--json"]) == 0
+    # the tiny preset: test shapes, and the 1-device mesh that keeps
+    # the train-step compile off the SPMD partitioner
+    assert seen == {"train_hw": (48, 64), "batch": 2, "iters": 2,
+                    "bucket": (40, 56), "lanes": 2, "num_data": 1}
+    out = json.loads(capsys.readouterr().out.strip())
+    assert [p["program"] for p in out["programs"]] == \
+        [c.program for c in canned]
+    assert out["programs"][0]["flops"] == 4.0e9
+    # CPU container: unknown peaks, honest Nones
+    assert out["peak_tflops"] is None
+    assert cli.main(["--tiny"]) == 0
+    txt = capsys.readouterr().out
+    assert "train_step" in txt and "serve_iter_40x56_b2" in txt
+    assert "unknown device peak" in txt
+    # non-tiny overrides flow through untouched
+    assert cli.main(["--image-size", "96x128", "--batch", "4",
+                     "--json"]) == 0
+    capsys.readouterr()
+    assert seen["train_hw"] == (96, 128) and seen["batch"] == 4
+    assert seen["num_data"] is None
+
+
+@pytest.mark.slow
+def test_cost_cli_tiny_smoke(capsys):
+    """The acceptance drill for real: `python -m raft_tpu cost --tiny`
+    compiles all four programs and every row lands nonzero, source=xla.
+    Four AOT compiles (~30 s CPU) put this in the slow tier; the
+    envelope/table contract above stays tier-1."""
+    from raft_tpu.cli import cost as cli
+
+    assert cli.main(["--tiny", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    progs = {p["program"]: p for p in out["programs"]}
+    assert set(progs) == {"train_step", "inference_48x64",
+                          "serve_enc_40x56_b2", "serve_iter_40x56_b2"}
+    for p in progs.values():
+        assert p["flops"] > 0 and p["bytes"] > 0, p
+        assert p["source"] == "xla"
+        assert p["flops_per_pair"] > 0
+    assert out["peak_tflops"] is None

@@ -147,6 +147,7 @@ def test_nonfinite_e2e_forensics_and_replay(tmp_path, monkeypatch):
     from raft_tpu.train.loop import train
 
     monkeypatch.setenv("RAFT_TELEMETRY_HBM", "0")
+    monkeypatch.setenv("RAFT_TELEMETRY_COST", "0")
     mcfg = RAFTConfig.small_model(corr_levels=2, corr_radius=2)
     tcfg = TrainConfig(name="t", num_steps=4, batch_size=8,
                        image_size=(24, 32), iters=2, val_freq=100,

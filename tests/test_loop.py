@@ -46,9 +46,10 @@ def test_train_loop_checkpoint_and_resume(tmp_path, monkeypatch):
         calls.append(1)
         return {"val/metric": 1.0}
 
-    # hbm snapshot would lower+compile the real step a second time;
-    # the fast tier covers the event, this test covers the stream.
+    # hbm/cost snapshots would lower+compile the real step a second
+    # time; the fast tier covers the events, this test the stream.
     monkeypatch.setenv("RAFT_TELEMETRY_HBM", "0")
+    monkeypatch.setenv("RAFT_TELEMETRY_COST", "0")
     tdir = tmp_path / "telemetry"
     state = train(mcfg, tcfg, _batches(10, tcfg),
                   validators={"fake": fake_validator},
