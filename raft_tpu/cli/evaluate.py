@@ -95,6 +95,21 @@ def parse_args(argv=None):
                         "plus iters_used p50/p95 (the serve knob it "
                         "gates is ServeConfig.early_exit_threshold; "
                         "docs/SERVING.md)")
+    p.add_argument("--quality-proxies", "--quality_proxies",
+                   action="store_true", dest="quality_proxies",
+                   help="calibration mode for the unsupervised quality "
+                        "proxies (raft_tpu/obs/quality.py): score every "
+                        "image with the label-free photometric / "
+                        "retirement-residual proxies the serve sampler "
+                        "emits and report each proxy's Spearman rank "
+                        "correlation with true EPE "
+                        "(docs/OBSERVABILITY.md)")
+    p.add_argument("--quality-cycle", "--quality_cycle",
+                   action="store_true", dest="quality_cycle",
+                   help="with --quality-proxies: also score "
+                        "forward-backward cycle consistency (second "
+                        "inference pass on swapped frames — doubles the "
+                        "forward cost)")
     p.add_argument("--alternate_corr", action="store_true",
                    help="memory-efficient on-demand correlation "
                         "(reference --alternate_corr)")
@@ -200,6 +215,34 @@ def main(argv=None):
                 "early_exit_delta_vs_full": result["delta_vs_full"],
                 "thresholds": result["thresholds"],
                 "per_threshold": result["per_threshold"],
+            },
+        }))
+        return
+
+    if args.quality_proxies:
+        # Proxy-calibration mode: Spearman(proxy, EPE) per dataset so
+        # the label-free serve/fleet quality signals are calibrated
+        # against ground truth, not vibes.
+        kwargs = dict(roots[args.dataset])
+        if args.dataset == "kitti":
+            kwargs["bucket"] = not args.no_bucket
+        result = evaluate.evaluate_quality_proxies(
+            variables, model_cfg, dataset=args.dataset, iters=iters,
+            batch_size=args.eval_batch, cycle=args.quality_cycle,
+            **kwargs)
+        # Bench-format record: check_regression.py reads
+        # config.quality_spearman off this series.
+        import json
+        print(json.dumps({
+            "metric": f"eval_quality_proxies_{args.dataset}",
+            "value": 1.0,
+            "unit": "pass",
+            "vs_baseline": 0.0,
+            "config": {
+                "quality_spearman": result["spearman"],
+                "proxy_means": result["proxy_means"],
+                "epe_mean": result["epe_mean"],
+                "n": result["n"],
             },
         }))
         return

@@ -163,6 +163,18 @@ def parse_args(argv=None):
                         "(0/unset = tracing off; errors, retries, and "
                         "hedges are tail-kept regardless once > 0); "
                         "default $RAFT_TRACE_SAMPLE_RATE")
+    p.add_argument("--quality-sample-rate", type=float, default=0.0,
+                   help="fraction of retiring slot-mode requests "
+                        "scored with the label-free photometric "
+                        "quality proxy (quality_score events, "
+                        "raft_quality_* metrics, drift detection; "
+                        "docs/OBSERVABILITY.md 'Flow quality'); "
+                        "0 = scoring off, zero hot-path overhead")
+    p.add_argument("--quality-cycle", action="store_true",
+                   help="with --quality-sample-rate > 0: also run a "
+                        "forward-backward cycle-consistency pass per "
+                        "scored request (one extra inference on the "
+                        "swapped frames)")
     return p.parse_args(argv)
 
 
@@ -407,6 +419,9 @@ def main(argv=None):
         retry_backoff_s=max(args.retry_backoff_s, 0.0),
         retry_backoff_max_s=max(ServeConfig.retry_backoff_max_s,
                                 args.retry_backoff_s),
+        quality_sample_rate=min(max(args.quality_sample_rate, 0.0),
+                                1.0),
+        quality_cycle=args.quality_cycle,
         # Fleet mode overrides this per engine build (FleetConfig owns
         # the artifact dir); single-engine mode imports at construction.
         aot_dir=args.aot_dir)

@@ -486,8 +486,10 @@ def _early_exit_flows(variables, runner, ds, mode: str, batch_size: int,
                       iters: int, threshold: float, target=None):
     """Stream ``ds`` through :class:`raft_tpu.serve.slots
     .EarlyExitRunner` in fixed-shape batches; yields ``(sample,
-    flow (H, W, 2) np unpadded, iters_used)`` per image — the
-    early-exit mirror of :func:`_batched_flows`."""
+    flow (H, W, 2) np unpadded, iters_used, residual)`` per image —
+    the early-exit mirror of :func:`_batched_flows`.  ``residual`` is
+    the lane's convergence ``delta_max`` at its retirement iteration
+    (the in-graph quality proxy, ``obs/quality.py``)."""
     n = len(ds)
     for start in range(0, n, batch_size):
         idxs = list(range(start, min(start + batch_size, n)))
@@ -501,11 +503,12 @@ def _early_exit_flows(variables, runner, ds, mode: str, batch_size: int,
             im1 += [im1[-1]] * pad_n
             im2 += [im2[-1]] * pad_n
         with span("raft_eval_forward", dataset=mode, emit=True):
-            flow_up, used = runner.run(variables, np.stack(im1),
-                                       np.stack(im2), iters, threshold)
+            flow_up, used, resid = runner.run(
+                variables, np.stack(im1), np.stack(im2), iters,
+                threshold, return_residuals=True)
         for j, (s, p) in enumerate(zip(samples, padders)):
             yield s, np.asarray(p.unpad(flow_up[j:j + 1])[0]), \
-                int(used[j])
+                int(used[j]), float(resid[j])
 
 
 def evaluate_early_exit_delta(variables, model_cfg: RAFTConfig,
@@ -528,9 +531,14 @@ def evaluate_early_exit_delta(variables, model_cfg: RAFTConfig,
     iters_used distribution (mean/p50/p95 — the throughput win).
 
     Returns ``{"dataset", "iters", "thresholds", "per_threshold":
-    {thr: {"epe", "epe_delta", "iters_mean", "iters_p50", "iters_p95"}},
-    "delta_vs_full": {thr: epe_delta}}`` with threshold keys rendered
-    as strings (JSON-stable).
+    {thr: {"epe", "epe_delta", "iters_mean", "iters_p50", "iters_p95",
+    "residual_mean", "residual_p50"}}, "delta_vs_full":
+    {thr: epe_delta}}`` with threshold keys rendered as strings
+    (JSON-stable).  The residual stats are the lanes' convergence
+    ``delta_max`` at retirement — the in-graph quality proxy
+    (``obs/quality.py``) — stamped next to the measured EPE delta so
+    the predicate that triggered the exit and the accuracy it cost sit
+    in the same record.
 
     The regression gate (``scripts/check_regression.py
     --max-early-exit-epe-delta``) reads the max ``delta_vs_full``
@@ -558,16 +566,18 @@ def evaluate_early_exit_delta(variables, model_cfg: RAFTConfig,
     per: Dict[str, Dict[str, float]] = {}
     base_epe = None
     for t in arms:
-        epes, used_all = [], []
+        epes, used_all, resid_all = [], [], []
         print(f"--- early_exit_threshold={t:g} ---", flush=True)
-        for sample, flow, used in _early_exit_flows(
+        for sample, flow, used, resid in _early_exit_flows(
                 variables, runner, ds, dataset, batch_size, iters, t,
                 target=target):
             epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
             epes.append(epe.reshape(-1))
             used_all.append(used)
+            resid_all.append(resid)
         epe = float(np.mean(np.concatenate(epes)))
         used_np = np.asarray(used_all, np.float64)
+        resid_np = np.asarray(resid_all, np.float64)
         if base_epe is None:
             base_epe = epe
         per[f"{t:g}"] = {
@@ -576,6 +586,8 @@ def evaluate_early_exit_delta(variables, model_cfg: RAFTConfig,
             "iters_mean": round(float(used_np.mean()), 3),
             "iters_p50": float(np.percentile(used_np, 50)),
             "iters_p95": float(np.percentile(used_np, 95)),
+            "residual_mean": round(float(resid_np.mean()), 6),
+            "residual_p50": round(float(np.percentile(resid_np, 50)), 6),
         }
         print(f"early-exit thr={t:g} [{dataset}]: EPE {epe:.4f} "
               f"(delta {epe - base_epe:+.4f}), iters p50 "
@@ -588,3 +600,105 @@ def evaluate_early_exit_delta(variables, model_cfg: RAFTConfig,
     return {"dataset": dataset, "iters": iters,
             "thresholds": [f"{t:g}" for t in arms],
             "per_threshold": per, "delta_vs_full": deltas}
+
+
+def evaluate_quality_proxies(variables, model_cfg: RAFTConfig,
+                             dataset: str = "chairs", iters: int = 24,
+                             batch_size: int = 4, bucket: bool = True,
+                             cycle: bool = False,
+                             **dataset_kwargs) -> Dict:
+    """Calibrate the unsupervised quality proxies
+    (:mod:`raft_tpu.obs.quality`) against ground truth: stream a
+    labeled dataset through the serve-identical
+    :class:`~raft_tpu.serve.slots.EarlyExitRunner`, score every sample
+    with the label-free proxies the production path emits, and report
+    the Spearman rank correlation of each proxy with the true per-image
+    EPE.
+
+    The point: serving scores live traffic with these proxies
+    (``ServeConfig.quality_sample_rate``) and the fleet gates weight
+    rollouts on them (``canary_proxy_budget``) — neither surface ever
+    sees a label.  This function is the receipt that the proxies RANK
+    bad flow as bad: a proxy with Spearman >= ~0.6 on labeled data is a
+    trustworthy drift/canary signal; one near 0 is vibes.
+
+    Proxies scored per image:
+
+    - ``photometric``: occlusion-masked charbonnier warp error
+      (:func:`raft_tpu.obs.quality.photometric_error`), the same
+      statistic the serve sampler records.
+    - ``residual``: convergence ``delta_max`` at lane retirement, the
+      in-graph early-exit predicate value.
+    - ``cycle`` (``cycle=True`` only — doubles the forward cost): a
+      second pass on swapped frames, forward-backward consistency via
+      :func:`raft_tpu.obs.quality.cycle_error`.
+
+    Returns ``{"dataset", "iters", "n", "epe_mean", "spearman":
+    {proxy: rho}, "proxy_means": {proxy: mean}}`` and emits an
+    ``eval_quality_proxies`` event with the same payload."""
+    try:
+        make_ds = EARLY_EXIT_DATASETS[dataset]
+    except KeyError:
+        raise ValueError(f"unknown dataset {dataset!r}; choose from "
+                         f"{sorted(EARLY_EXIT_DATASETS)}")
+    from raft_tpu.obs import quality
+    from raft_tpu.serve.slots import EarlyExitRunner
+
+    runner = EarlyExitRunner(make_inference_model(model_cfg).config)
+    ds = make_ds(**dataset_kwargs)
+    target = _bucket_hw(ds) if bucket else None
+    epes, photo, resids, flows_fw = [], [], [], []
+    for sample, flow, _used, resid in _early_exit_flows(
+            variables, runner, ds, dataset, batch_size, iters, 0.0,
+            target=target):
+        epe = np.sqrt(np.sum((flow - sample["flow"]) ** 2, axis=-1))
+        epes.append(float(epe.mean()))
+        scores = quality.score_pair(sample["image1"], sample["image2"],
+                                    flow)
+        photo.append(scores["photometric"])
+        resids.append(resid)
+        if cycle:
+            flows_fw.append(flow)
+    proxies = {"photometric": photo, "residual": resids}
+    if cycle:
+        cyc = []
+        swapped = _SwappedPairs(ds)
+        for k, (_s, flow_bw, _u, _r) in enumerate(_early_exit_flows(
+                variables, runner, swapped, dataset, batch_size, iters,
+                0.0, target=target)):
+            err, _occ = quality.cycle_error(flows_fw[k][None],
+                                            flow_bw[None])
+            cyc.append(float(np.asarray(err)[0]))
+        proxies["cycle"] = cyc
+    spear = {k: round(quality.spearman(v, epes), 4)
+             for k, v in proxies.items()}
+    rec = {
+        "dataset": dataset, "iters": iters, "n": len(epes),
+        "epe_mean": round(float(np.mean(epes)), 6),
+        "spearman": spear,
+        "proxy_means": {k: round(float(np.mean(v)), 6)
+                        for k, v in proxies.items()},
+    }
+    default_sink().emit("eval_quality_proxies", **rec)
+    for k, rho in spear.items():
+        print(f"quality proxy [{dataset}] {k}: spearman(EPE) "
+              f"{rho:+.4f}", flush=True)
+    return rec
+
+
+class _SwappedPairs:
+    """Frame-swapped view of an eval dataset: ``image1``/``image2``
+    exchanged (flow passed through untouched) so a second
+    :func:`_early_exit_flows` pass yields the BACKWARD flow for the
+    cycle-consistency proxy."""
+
+    def __init__(self, ds):
+        self._ds = ds
+
+    def __len__(self):
+        return len(self._ds)
+
+    def load(self, i):
+        s = dict(self._ds.load(i))
+        s["image1"], s["image2"] = s["image2"], s["image1"]
+        return s

@@ -152,7 +152,21 @@ class ServeConfig:
     (full budget always runs).  Slot mode honors a per-request
     ``iters`` budget (capped at ``cfg.iters``); request mode runs every
     lane to ``cfg.iters`` (lockstep).  All three are tuning-registry
-    knobs (``scripts/autotune.py --kind serve``)."""
+    knobs (``scripts/autotune.py --kind serve``).
+    ``quality_sample_rate``: fraction of retiring slot-mode requests
+    scored with the label-free photometric quality proxy
+    (``raft_tpu/obs/quality.py``; docs/OBSERVABILITY.md "Flow
+    quality") — scored requests emit ``quality_score`` events and feed
+    the ``raft_quality_*`` histograms plus the drift detector; the
+    free convergence residual is recorded for EVERY retirement while
+    sampling is on.  ``0`` (the default) disables quality scoring
+    entirely: no monitor is built, no extra device fetch or program
+    exists on the hot path (the zero-overhead contract,
+    tests/test_quality.py).  ``quality_cycle`` additionally runs a
+    sampled forward-backward cycle-consistency pass (one extra
+    inference on the swapped frames per scored request).  The
+    ``quality_drift_*`` knobs size the PSI drift detector (reference
+    sample count, rolling window, firing threshold)."""
 
     iters: int = 32
     max_batch: int = 8
@@ -176,6 +190,11 @@ class ServeConfig:
     batching: str = "request"
     slots: int = 8
     early_exit_threshold: float = 0.0
+    quality_sample_rate: float = 0.0
+    quality_cycle: bool = False
+    quality_drift_reference: int = 256
+    quality_drift_window: int = 64
+    quality_drift_threshold: float = 0.5
 
     def __post_init__(self):
         if self.max_batch < 1 or self.max_queue < 1:
@@ -188,6 +207,17 @@ class ServeConfig:
         if self.early_exit_threshold < 0:
             raise ValueError("early_exit_threshold must be >= 0 "
                              "(0 disables early exit)")
+        if not 0.0 <= self.quality_sample_rate <= 1.0:
+            raise ValueError(
+                f"quality_sample_rate must be in [0, 1] (0 disables "
+                f"quality scoring), got {self.quality_sample_rate}")
+        if (self.quality_drift_reference < 4
+                or self.quality_drift_window < 2
+                or self.quality_drift_threshold <= 0):
+            raise ValueError(
+                "need quality_drift_reference >= 4, "
+                "quality_drift_window >= 2 and "
+                "quality_drift_threshold > 0")
         if self.max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
         if self.stall_timeout_s < 0:
@@ -394,6 +424,23 @@ class InferenceEngine:
                  "retiring (early exit / per-request budget)",
             scale=1.0, suffix="")
         self._counters = Counters(registry=self.registry)
+        # Flow-quality scoring (obs/quality.py): built ONLY when the
+        # sample rate is nonzero — at 0 the hot path carries no
+        # monitor, no extra device fetch in _iter_slots, and no
+        # quality program (the zero-overhead pin,
+        # tests/test_quality.py).
+        self._quality = None
+        if cfg.quality_sample_rate > 0:
+            from raft_tpu.obs import quality as quality_mod
+
+            self._quality = quality_mod.QualityMonitor(
+                registry=self.registry, sink=self._sink,
+                sample_rate=cfg.quality_sample_rate,
+                cycle=cfg.quality_cycle,
+                drift_reference=cfg.quality_drift_reference,
+                drift_window=cfg.quality_drift_window,
+                drift_threshold=cfg.quality_drift_threshold,
+                reservoir=cfg.latency_window)
         # Compile-time work accounting, keyed by the SAME (bucket,
         # lanes, prog) ledger keys as _executables: stamped once in
         # _get_programs, read back by spans/stats with zero device
@@ -704,6 +751,14 @@ class InferenceEngine:
         drift)."""
         return self.registry.render_prometheus()
 
+    def quality_drift(self) -> Optional[dict]:
+        """Per-proxy drift-detector state (``None`` when quality
+        scoring is disabled) — the fleet supervisor polls this to
+        surface ``fleet_quality_drift`` events."""
+        if self._quality is None:
+            return None
+        return self._quality.drift_snapshot()
+
     def stats(self) -> dict:
         """One JSON-able snapshot: counters, latency percentiles over the
         recent window, per-``(bucket, batch)`` compile counts."""
@@ -730,6 +785,12 @@ class InferenceEngine:
         # AOT warm-start provenance: how many executables this engine
         # imported instead of compiling (docs/SERVING.md fleet section).
         out["aot"] = dict(self.aot_info)
+        # Flow-quality snapshot (obs/quality.py): per-proxy p50/p95 +
+        # drift-detector state when sampling is on; a bare disabled
+        # marker otherwise, so clients can branch without a key check.
+        out["quality"] = (self._quality.snapshot()
+                          if self._quality is not None
+                          else {"enabled": False})
         # Compile-time work accounting per ledger key (obs/cost.py):
         # the `raft_tpu cost` table and bench_serve's per-pair stamps
         # read this — flops/bytes/roofline, captured once at compile.
@@ -1288,6 +1349,11 @@ class InferenceEngine:
             return
         flow_np = np.asarray(flow_up)
         converged_np = np.asarray(state["converged"])
+        # delta_max is only fetched when the quality monitor exists —
+        # at quality_sample_rate=0 the retirement path transfers
+        # exactly what it always did (the zero-overhead contract).
+        dmax_np = (np.asarray(state["delta_max"])
+                   if self._quality is not None else None)
         for i in np.nonzero(newly)[0]:
             i = int(i)
             r = pool.reqs[i]
@@ -1305,10 +1371,29 @@ class InferenceEngine:
                             iters=used,
                             converged=bool(converged_np[i]),
                             seconds=round(t_done - r.t_submit, 6))
+            qattrs = None
+            if self._quality is not None:
+                qattrs = self._quality.note_retirement(
+                    future=r.future, image1=r.image1, image2=r.image2,
+                    flow=out, bucket=bk, residual=float(dmax_np[i]),
+                    converged=bool(converged_np[i]), iters=used)
+                if qattrs is not None and self.cfg.quality_cycle:
+                    # Sampled forward-backward pass: score THIS flow
+                    # against a second inference on the swapped
+                    # frames.  Best-effort — backpressure or an
+                    # engine racing stop() just skips the cycle
+                    # measurement, never fails the retirement.
+                    try:
+                        bfut = self.submit(r.image2, r.image1,
+                                           iters=r.iters)
+                    except Exception:
+                        pass
+                    else:
+                        self._quality.begin_cycle(bfut, out, bk)
             if r.trace is not None:
                 trace.record_span(r.trace, "device", pool.t_admit[i],
                                   t_done, bucket=bk, iters=used,
-                                  retries=retries)
+                                  retries=retries, **(qattrs or {}))
                 if retries:  # tail-keep: a retried request is news
                     r.trace.mark_keep()
             with self._pending_lock:

@@ -25,13 +25,24 @@ request with it.  The fleet owns N engine replicas and keeps the
 - **Rolling weight updates** — :meth:`ReplicaFleet.update_weights`
   loads checkpoint N+1 on a *warming* engine while the fleet keeps
   serving N, and flips replicas one at a time (old engine drains, new
-  one takes over atomically under the replica lock) ONLY after two
+  one takes over atomically under the replica lock) ONLY after the
   gates pass: the checkpoint verifies (an actual restore of the newest
   step — the only check that proves the bytes decode, same machinery
-  as ``verify-ckpt``) and a canary inference on the warming engine
-  returns finite flow of the right shape.  A torn checkpoint or a
-  NaN-producing weight set is refused with
+  as ``verify-ckpt``), a canary inference on the warming engine
+  returns finite flow of the right shape, and a golden-batch QUALITY
+  comparison (label-free photometric proxy, ``obs/quality.py``) shows
+  the new weights not regressing beyond
+  ``FleetConfig.canary_proxy_budget`` vs the live fleet on
+  deterministic low-motion frames.  A torn checkpoint, a NaN-producing
+  weight set, or finite-but-garbage weights (wild flow the old
+  shape+finiteness canary waved through) is refused with
   :class:`WeightUpdateError`; the fleet keeps serving version N.
+- **Quality-drift surfacing** — the supervisor also polls each
+  replica's quality drift detectors (``engine.quality_drift()``,
+  populated when ``ServeConfig.quality_sample_rate > 0``) and
+  forwards new firings as ``fleet_quality_drift`` events +
+  ``raft_fleet_quality_drift_total``, so one stream shows WHICH
+  replica's serving quality walked away from its reference.
 
 The fleet does placement-free supervision only; request routing
 (affinity, failover, hedging) lives in
@@ -60,8 +71,58 @@ from raft_tpu.serve.engine import InferenceEngine, ServeConfig
 
 class WeightUpdateError(RuntimeError):
     """A rolling weight update was refused at a gate (checkpoint failed
-    to verify, canary inference failed) or could not complete.  The
-    fleet keeps serving its current weights."""
+    to verify, canary inference failed, golden-batch proxy score
+    regressed past ``canary_proxy_budget``) or could not complete.
+    The fleet keeps serving its current weights."""
+
+
+def _golden_frames(h: int, w: int, seed: int = 0,
+                   shift: Tuple[int, int] = (2, 1)):
+    """Deterministic low-motion golden frame pair for the proxy canary:
+    a smoothed random image and a small-translation crop of it.
+
+    Smooth content + small true motion means any sane weight set —
+    including a freshly initialized one predicting near-zero flow —
+    scores a LOW photometric canary (the residual a couple of pixels
+    of uncompensated shift causes on blurred content is tiny), while
+    scrambled weights produce wild flow that lands out of bounds or on
+    unrelated content and score high.  That separation, not absolute
+    accuracy, is what the rolling-update gate needs."""
+    rng = np.random.default_rng(seed)
+    pad = 8
+    base = rng.uniform(0.0, 255.0, (h + 2 * pad, w + 2 * pad, 3))
+    kern = np.ones(9) / 9.0
+    for ax in (0, 1):
+        base = np.apply_along_axis(
+            lambda m: np.convolve(m, kern, mode="same"), ax, base)
+    lo, hi = float(base.min()), float(base.max())
+    base = (base - lo) / max(hi - lo, 1e-6) * 255.0
+    dy, dx = shift
+    im1 = base[pad:pad + h, pad:pad + w].astype(np.float32)
+    im2 = base[pad - dy:pad - dy + h,
+               pad - dx:pad - dx + w].astype(np.float32)
+    return im1, im2
+
+
+def _golden_proxy_scores(engine: InferenceEngine, shapes) -> dict:
+    """Label-free quality scores of ``engine``'s weights on the golden
+    frames, one inference per shape; the scalar ``score`` (mean canary
+    score: photometric error + out-of-bounds fraction,
+    ``obs/quality.py``) is what the update gate compares."""
+    from raft_tpu.obs import quality as quality_mod
+
+    per = []
+    for (h, w) in shapes:
+        im1, im2 = _golden_frames(int(h), int(w))
+        flow = engine.infer(im1, im2, timeout=300)
+        s = quality_mod.score_pair(im1, im2, flow)
+        per.append({"shape": [int(h), int(w)],
+                    "photometric": round(s["photometric"], 5),
+                    "valid_frac": round(s["valid_frac"], 4),
+                    "canary": round(s["canary"], 5)})
+    return {"score": round(float(np.mean([p["canary"] for p in per])),
+                           5),
+            "per_shape": per}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,6 +151,21 @@ class FleetConfig:
     warmup_shapes: Tuple[Tuple[int, int], ...] = ()
     canary_shapes: Tuple[Tuple[int, int], ...] = ()
     drain_timeout_s: float = 30.0
+    #: Golden-batch proxy gate for rolling weight updates: the warming
+    #: engine's label-free quality score (obs/quality.py canary score:
+    #: photometric error + out-of-bounds fraction, on deterministic
+    #: low-motion golden frames) may regress at most this RELATIVE
+    #: fraction vs the live fleet's score before the update is refused
+    #: (3.0 = the new weights may score up to 4x worse).  The budget is
+    #: deliberately loose: legitimate weight swaps (even between
+    #: unrelated random inits) score within ~1.6x of each other on the
+    #: golden frames, while scrambled-but-finite weights produce wild
+    #: flow and blow past 16x — exactly the failure mode the PR 8
+    #: shape+finiteness canary waved through.  ``None`` disables the
+    #: proxy gate (shape/finiteness checks still run).  Uses
+    #: ``canary_shapes``/``warmup_shapes``; with neither configured the
+    #: proxy gate is skipped (scoring an unwarmed shape would compile).
+    canary_proxy_budget: Optional[float] = 3.0
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -101,6 +177,11 @@ class FleetConfig:
             raise ValueError("restart_jitter must be in [0, 1)")
         if self.max_restart_failures < 1:
             raise ValueError("max_restart_failures must be >= 1")
+        if (self.canary_proxy_budget is not None
+                and self.canary_proxy_budget <= 0):
+            raise ValueError(
+                "canary_proxy_budget must be > 0 (None disables the "
+                "proxy gate)")
 
 
 class _LabeledSink:
@@ -246,6 +327,17 @@ class ReplicaFleet:
         self._weight_updates = self.registry.counter(
             "raft_fleet_weight_updates_total",
             "rolling weight updates, by outcome")
+        self._quality_drifts = self.registry.counter(
+            "raft_fleet_quality_drift_total",
+            "replica-local quality_drift firings surfaced by the "
+            "supervisor, by replica and proxy")
+        # Quality-drift dedup per (replica, engine generation, proxy)
+        # and the cached golden-batch reference scores of the CURRENT
+        # serving weights (update_weights' proxy gate; invalidated by
+        # each successful flip — the new weights become the reference).
+        self._drift_seen: Dict[tuple, int] = {}
+        self._golden_ref: Optional[dict] = None
+        self._pending_golden: Optional[dict] = None
         self._replica_gauge = self.registry.gauge(
             "raft_fleet_replicas", "replicas by current state")
         self._version_gauge = self.registry.gauge(
@@ -349,13 +441,39 @@ class ReplicaFleet:
                     continue
                 if eng.crashed:
                     self._restart(r, "crash")
-                elif eng.health()["stalled"]:
+                    continue
+                if eng.health()["stalled"]:
                     self._restart(r, "stall")
-                elif (r.backoff_level
-                      and r.ready_since is not None
-                      and time.monotonic() - r.ready_since
-                      > self.fleet_cfg.backoff_reset_s):
+                    continue
+                if (r.backoff_level
+                        and r.ready_since is not None
+                        and time.monotonic() - r.ready_since
+                        > self.fleet_cfg.backoff_reset_s):
                     r.backoff_level = 0
+                self._note_quality_drift(r, eng)
+
+    def _note_quality_drift(self, r: Replica,
+                            eng: InferenceEngine) -> None:
+        """Forward each NEW replica-local quality_drift firing
+        (engine drift detectors, obs/quality.py) as one fleet-level
+        ``fleet_quality_drift`` event + counter bump.  Dedup is per
+        (replica, engine generation, proxy): a restarted or flipped
+        engine starts a fresh drift history."""
+        try:
+            drift = eng.quality_drift()
+        except Exception:
+            return
+        if not drift:
+            return
+        for proxy, st in drift.items():
+            events = int(st.get("events", 0))
+            key = (r.name, r.generation, proxy)
+            if events > self._drift_seen.get(key, 0):
+                self._drift_seen[key] = events
+                self._quality_drifts.inc(replica=r.name, proxy=proxy)
+                self._sink.emit("fleet_quality_drift", replica=r.name,
+                                proxy=proxy, score=st.get("score"),
+                                events=events)
 
     def _backoff(self, level: int) -> float:
         cfg = self.fleet_cfg
@@ -426,8 +544,10 @@ class ReplicaFleet:
         run layout — run layouts are integrity-verified by actually
         restoring the newest step first) or an in-memory variables
         pytree.  Gates: verify-ckpt, then a canary inference on the
-        warming engine (finite flow, correct shape).  Only after both
-        pass does any serving replica flip; flips are one replica at a
+        warming engine (finite flow, correct shape), then the
+        golden-batch quality-proxy comparison vs the live fleet
+        (``canary_proxy_budget``).  Only after all pass does any
+        serving replica flip; flips are one replica at a
         time, atomic per replica, old engine drained.  Raises
         :class:`WeightUpdateError` at any gate — the fleet keeps
         serving its current weights."""
@@ -489,6 +609,11 @@ class ReplicaFleet:
                 self._warming = None
                 warming.stop(drain=False, timeout=5)
             self.weights_version += 1
+            if self._pending_golden is not None:
+                # The flipped weights are the new golden reference for
+                # the NEXT update's proxy comparison.
+                self._golden_ref = self._pending_golden
+                self._pending_golden = None
             self._weight_updates.inc(ok="true")
             report = {"ok": True, "version": self.weights_version,
                       "flipped": flipped, "provenance": provenance,
@@ -503,6 +628,7 @@ class ReplicaFleet:
         # update_weights' critical section; the *_locked suffix is the
         # lock-discipline convention — docs/ANALYSIS.md, LOCK201).
         self._warming = None
+        self._pending_golden = None
         if warming is not None:
             try:
                 warming.stop(drain=False, timeout=5)
@@ -580,11 +706,15 @@ class ReplicaFleet:
         return new_vars
 
     def _canary(self, warming: InferenceEngine) -> dict:
-        """Canary gate: the warming engine must produce finite flow of
-        the right shape on synthetic frames before ANY live replica
-        flips.  (No numeric comparison against the live fleet — the
-        weights are supposed to differ; what must not differ is
-        contract: shape, dtype, finiteness.)"""
+        """Canary gates: the warming engine must (1) produce finite
+        flow of the right shape on synthetic frames, and (2) not
+        regress the golden-batch quality proxy beyond
+        ``canary_proxy_budget`` relative to the live fleet
+        (``obs/quality.py`` canary score on deterministic low-motion
+        frames — the check that catches finite-but-garbage weights the
+        contract checks wave through).  The proxy comparison is
+        skipped (recorded, not failed) when no live replica can score
+        the reference or no canary/warmup shapes are configured."""
         shapes = (self.fleet_cfg.canary_shapes
                   or self.fleet_cfg.warmup_shapes or ((64, 96),))
         rng = np.random.default_rng(0)
@@ -608,7 +738,67 @@ class ReplicaFleet:
             report.append({"shape": [h, w],
                            "flow_abs_mean":
                                round(float(np.abs(flow).mean()), 4)})
-        return report
+        return {"frames": report,
+                "proxy": self._canary_proxy_gate_locked(warming)}
+
+    def _canary_proxy_gate_locked(self, warming: InferenceEngine
+                           ) -> Optional[dict]:
+        budget = self.fleet_cfg.canary_proxy_budget
+        shapes = (self.fleet_cfg.canary_shapes
+                  or self.fleet_cfg.warmup_shapes)
+        if budget is None or not shapes:
+            return None
+        ref = self._live_golden_scores_locked(shapes)
+        try:
+            new = _golden_proxy_scores(warming, shapes)
+        except Exception as e:
+            raise WeightUpdateError(
+                f"canary proxy scoring failed: "
+                f"{type(e).__name__}: {e}") from e
+        self._pending_golden = new
+        if ref is None:
+            return {"skipped": "no live reference replica",
+                    "new": new["score"]}
+        delta = ((new["score"] - ref["score"])
+                 / max(abs(ref["score"]), 1e-6))
+        ok = delta <= budget
+        proxy = {"old": ref["score"], "new": new["score"],
+                 "delta_pct": round(100.0 * delta, 2),
+                 "budget_pct": round(100.0 * budget, 2),
+                 "ok": ok}
+        self._sink.emit("fleet_canary_proxy", **proxy)
+        if not ok:
+            raise WeightUpdateError(
+                f"canary proxy regression: golden-batch quality score "
+                f"{new['score']:.4f} vs live {ref['score']:.4f} "
+                f"({100.0 * delta:+.1f}% > canary_proxy_budget "
+                f"{100.0 * budget:.0f}%) — refusing to roll these "
+                f"weights out")
+        return proxy
+
+    def _live_golden_scores_locked(self, shapes) -> Optional[dict]:
+        """Golden-batch scores of the CURRENT serving weights, lazily
+        computed on the first eligible replica and cached until a
+        successful flip replaces them (the flipped weights become the
+        next update's reference)."""
+        if self._golden_ref is not None:
+            return self._golden_ref
+        for r in self.replicas:
+            if not r.eligible():
+                continue
+            eng = r.engine
+            if eng is None:
+                continue
+            try:
+                self._golden_ref = _golden_proxy_scores(eng, shapes)
+                return self._golden_ref
+            except Exception as e:
+                self._sink.emit("fleet_canary_proxy_ref_error",
+                                replica=r.name,
+                                error=f"{type(e).__name__}: "
+                                      f"{str(e)[:300]}")
+                return None
+        return None
 
     # ------------------------------------------------------------------
     # introspection
@@ -621,9 +811,14 @@ class ReplicaFleet:
         for r in self.replicas:
             eng = r.engine
             h = eng.health() if eng is not None else {"ready": False}
+            extra = {}
+            drift = (eng.quality_drift() if eng is not None else None)
+            if drift is not None:
+                extra["quality_drifted"] = any(
+                    bool(s.get("drifted")) for s in drift.values())
             reps[r.name] = dict(h, state=r.state, restarts=r.restarts,
                                 generation=r.generation,
-                                breaker_open=r.breaker_open())
+                                breaker_open=r.breaker_open(), **extra)
         return {"ready": any(r.eligible() for r in self.replicas),
                 "weights_version": self.weights_version,
                 "replicas": reps}

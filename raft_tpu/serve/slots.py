@@ -206,10 +206,17 @@ class EarlyExitRunner:
         self._iter = jax.jit(make_iter_fn(model_cfg))
 
     def run(self, variables, image1, image2, iters: int,
-            threshold: float = 0.0):
+            threshold: float = 0.0, return_residuals: bool = False):
         """``(flow_up (B, H, W, 2) f32, iters_used (B,) i32)`` for a
         ``/8``-aligned batch.  ``threshold <= 0`` reproduces the full
-        ``iters``-step baseline."""
+        ``iters``-step baseline.
+
+        ``return_residuals=True`` appends the per-lane convergence
+        residual ``delta_max`` (max flow-update magnitude, flow units
+        at 1/8 resolution) captured at EACH lane's retirement
+        iteration — the in-graph quality proxy ``obs/quality.py``
+        calibrates against EPE.  Off by default so the baseline path
+        transfers exactly what it always did."""
         B = int(np.asarray(image1).shape[0])
         admit = jnp.ones((B,), jnp.bool_)
         budgets = jnp.full((B,), int(iters), jnp.int32)
@@ -221,6 +228,7 @@ class EarlyExitRunner:
         out = None
         prev_active = np.ones((B,), bool)
         iters_used = np.zeros((B,), np.int32)
+        residuals = np.full((B,), -1.0, np.float32)
         for _ in range(int(iters)):
             state, flow_up = self._iter(variables, state, thr)
             active = np.asarray(state["active"])
@@ -231,10 +239,15 @@ class EarlyExitRunner:
                     out = np.zeros(flow_np.shape, np.float32)
                 out[newly] = flow_np[newly]
                 iters_used[newly] = np.asarray(state["iters_done"])[newly]
+                if return_residuals:
+                    residuals[newly] = np.asarray(
+                        state["delta_max"])[newly]
             prev_active = active
             if not active.any():
                 break
         assert out is not None and not prev_active.any(), \
             "lanes left active after their budget — iter_step retire " \
             "logic is broken"
+        if return_residuals:
+            return out, iters_used, residuals
         return out, iters_used

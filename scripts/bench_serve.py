@@ -43,6 +43,11 @@ over submitted, 429 sheds excluded) and ``retries_total`` so
 ``scripts/check_regression.py --max-serve-error-rate`` can gate the
 series — a fleet that posts throughput while losing requests fails.
 
+``--quality-sample-rate`` (slot mode) turns on sampled scoring with the
+unsupervised flow-quality proxies (``raft_tpu/obs/quality.py``); the
+record then carries per-proxy p50/p95 (``quality``) next to the latency
+percentiles — throughput, latency, and output quality in one line.
+
 ``--tiny``: CPU-friendly smoke preset (small model, fp32, 3 iters, two
 tiny resolutions, ``--batching both``) so the serving path — and the
 slot-vs-request comparison — stays testable without hardware::
@@ -106,6 +111,13 @@ def parse_args(argv=None):
                         "record for check_regression.py "
                         "--max-early-exit-epe-delta; with the threshold "
                         "at 0 the delta is exactly 0 and stamps itself")
+    p.add_argument("--quality-sample-rate", type=float, default=0.0,
+                   help="slot mode: score this fraction of retiring "
+                        "requests with the unsupervised flow-quality "
+                        "proxies (raft_tpu/obs/quality.py); the record "
+                        "then carries per-proxy p50/p95 next to the "
+                        "latency percentiles (0 = off, the zero-"
+                        "overhead default)")
     p.add_argument("--easy-frac", type=float, default=0.5,
                    help="fraction of requests that are low-motion pairs "
                         "with a reduced per-request iteration budget "
@@ -328,6 +340,8 @@ def _run_arm(args, variables, model_cfg, workload, shapes,
         if args.batch_sizes else None,
         batching=batching, slots=args.slots,
         early_exit_threshold=args.early_exit_threshold
+        if batching == "slot" else 0.0,
+        quality_sample_rate=min(max(args.quality_sample_rate, 0.0), 1.0)
         if batching == "slot" else 0.0)
     fleet = None
     if args.replicas > 1:
@@ -405,6 +419,12 @@ def _run_arm(args, variables, model_cfg, workload, shapes,
         arm["compiles"] = stats["compiles"]
         arm["iters_used"] = stats.get("iters_used")
         arm["cost"] = stats.get("cost")
+        # Sampled flow-quality proxies (raft_tpu/obs/quality.py):
+        # per-proxy p50/p95 ride next to the latency percentiles when
+        # quality scoring is on; {"enabled": False} arms stay silent.
+        q = stats.get("quality")
+        if isinstance(q, dict) and q.get("enabled"):
+            arm["quality"] = q
         arm.update(_arm_cost_fields(stats, args.iters, arm["value"]))
     return arm
 
@@ -483,7 +503,7 @@ def main(argv=None):
                    ("latency_ms", "rejected", "errors", "timeouts",
                     "error_rate", "retries_total", "occupancy",
                     "compiles", "iters_used", "cost", "flops_per_pair",
-                    "achieved_tflops", "mfu") if k in head})
+                    "achieved_tflops", "mfu", "quality") if k in head})
     for k in ("replicas", "router"):
         if k in head:
             record[k] = head[k]

@@ -27,7 +27,14 @@ NEWEST record of each series is gated:
   --early_exit_threshold`` / ``bench_serve.py``) or the raw
   ``config.early_exit_delta_vs_full`` arm dict; exceeding the budget
   -> exit 1, and NO record carrying the figure also -> exit 1 (an
-  accuracy gate must not pass because the sweep silently didn't run).
+  accuracy gate must not pass because the sweep silently didn't run);
+- optional ``--max-quality-drift`` / ``--max-canary-proxy-delta``:
+  flow-quality gates over the unsupervised proxies
+  (``raft_tpu/obs/quality.py``) — the peak PSI drift score of the
+  production quality distribution (``config.quality_drift_score``)
+  and the golden-batch proxy regression of the last weight-update
+  canary (``config.canary_proxy_delta_pct``).  Both fail vacuously
+  when no record carries the figure, like ``--min-mfu``.
 
 Records with ``value: null`` (backend unavailable — the CPU container
 writing TPU series) are reported but never gate, so the check is safe
@@ -94,6 +101,27 @@ def parse_args(argv=None):
                         "over config.early_exit_delta_vs_full) exceeds "
                         "this; also fails when NO record carries the "
                         "figure (unset = no check)")
+    p.add_argument("--max-quality-drift", type=float, default=None,
+                   metavar="SCORE",
+                   help="fail when a newest record's "
+                        "config.quality_drift_score (peak PSI of the "
+                        "flow-quality drift detector, from "
+                        "scripts/telemetry_summary.py / "
+                        "scripts/quality_smoke.py; "
+                        "docs/OBSERVABILITY.md) exceeds this; also "
+                        "fails when NO record carries the figure — a "
+                        "drift gate must not pass because quality "
+                        "scoring silently turned off (unset = no "
+                        "check)")
+    p.add_argument("--max-canary-proxy-delta", type=float, default=None,
+                   metavar="PCT",
+                   help="fail when a newest record's "
+                        "config.canary_proxy_delta_pct (relative "
+                        "golden-batch proxy regression %% of the last "
+                        "weight-update canary, from "
+                        "scripts/quality_smoke.py) exceeds this; also "
+                        "fails when NO record carries the figure "
+                        "(unset = no check)")
     p.add_argument("--max-critical-path-ms", action="append",
                    default=[], metavar="NAME:MS",
                    help="fail when a newest record's "
@@ -222,7 +250,8 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
           max_quarantined=0, max_ckpt_fallback=0, require_tuned=False,
           max_serve_error_rate=0.0, max_critical_path_ms=None,
           max_early_exit_epe_delta=None, max_kernel_slowdown=None,
-          min_mfu=None, max_flops_per_pair_growth=None):
+          min_mfu=None, max_flops_per_pair_growth=None,
+          max_quality_drift=None, max_canary_proxy_delta=None):
     """``(failures, report)`` over the newest record of each metric."""
     failures, report = [], []
     cp_gates = dict(max_critical_path_ms or {})
@@ -233,6 +262,8 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
     mfu_seen = set()
     ee_seen = False
     fpp_seen = False
+    qd_seen = False
+    cpx_seen = False
     for metric, recs in sorted(series.items()):
         newest = recs[-1]
         value = newest.get("value")
@@ -371,6 +402,32 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
                         f"budget {max_early_exit_epe_delta:g} — the "
                         "convergence threshold is trading too much "
                         "accuracy for latency")
+        # Flow-quality gates (docs/OBSERVABILITY.md): the PSI drift
+        # score is the unsupervised production-quality signal
+        # (raft_tpu/obs/quality.py), the canary proxy delta is what the
+        # last weight-update canary measured on the golden batch.  Like
+        # --min-mfu, a gate with no qualifying record FAILS — quality
+        # scoring silently off must not look like quality stable.
+        if max_quality_drift is not None:
+            qd = cfg.get("quality_drift_score")
+            if isinstance(qd, (int, float)):
+                qd_seen = True
+                if qd > max_quality_drift:
+                    failures.append(
+                        f"{metric}: quality_drift_score {qd:g} > budget "
+                        f"{max_quality_drift:g} — the flow-quality "
+                        "proxy distribution shifted vs its reference "
+                        "(input drift or a bad weight rollout)")
+        if max_canary_proxy_delta is not None:
+            cpx = cfg.get("canary_proxy_delta_pct")
+            if isinstance(cpx, (int, float)):
+                cpx_seen = True
+                if cpx > max_canary_proxy_delta:
+                    failures.append(
+                        f"{metric}: canary_proxy_delta_pct {cpx:g}% > "
+                        f"budget {max_canary_proxy_delta:g}% — the "
+                        "weight-update canary scored worse on the "
+                        "golden batch than the live fleet")
         sn = cfg.get("serve_span_names")
         if isinstance(sn, list) and sn:
             missing = sorted(set(SERVE_REQUIRED_SPANS) - set(sn))
@@ -431,6 +488,19 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
             "config.early_exit_epe_delta (or early_exit_delta_vs_full) "
             "— the accuracy sweep did not run; the gate cannot pass "
             "vacuously")
+    if max_quality_drift is not None and not qd_seen:
+        failures.append(
+            "quality-drift gate: no record carries "
+            "config.quality_drift_score — quality scoring did not run "
+            "(ServeConfig.quality_sample_rate 0, or the summary "
+            "predates the quality proxies); the gate cannot pass "
+            "vacuously")
+    if max_canary_proxy_delta is not None and not cpx_seen:
+        failures.append(
+            "canary-proxy gate: no record carries "
+            "config.canary_proxy_delta_pct — no proxy-gated weight "
+            "update ran (canary_proxy_budget unset, or no update "
+            "happened); the gate cannot pass vacuously")
     return failures, report
 
 
@@ -639,6 +709,32 @@ def _selftest() -> int:
          run([30.0, 31.0, 30.5],
              cfgs=[{"flops_per_pair": 1e9}, {"flops_per_pair": 1e9},
                    {"flops_per_pair": 9e9}]), False),
+        ("quality drift within budget passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"quality_drift_score": 0.2},
+             max_quality_drift=0.5), False),
+        ("quality drift over budget fails",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"quality_drift_score": 1.7},
+             max_quality_drift=0.5), True),
+        ("quality-drift gate without data fails",
+         run([30.0, 31.0, 30.5], max_quality_drift=0.5), True),
+        ("high drift score without the gate passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"quality_drift_score": 9.0}), False),
+        ("canary proxy delta within budget passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"canary_proxy_delta_pct": 12.0},
+             max_canary_proxy_delta=50.0), False),
+        ("canary proxy delta over budget fails",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"canary_proxy_delta_pct": 180.0},
+             max_canary_proxy_delta=50.0), True),
+        ("canary-proxy gate without data fails",
+         run([30.0, 31.0, 30.5], max_canary_proxy_delta=50.0), True),
+        ("canary proxy delta without the gate passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"canary_proxy_delta_pct": 999.0}), False),
     ]
 
     def run_lint(payload):
@@ -709,7 +805,10 @@ def main(argv=None):
                                  args.min_mfu, "--min-mfu",
                                  ("PCT", "train_throughput:40")),
                              max_flops_per_pair_growth=(
-                                 args.max_flops_per_pair_growth))
+                                 args.max_flops_per_pair_growth),
+                             max_quality_drift=args.max_quality_drift,
+                             max_canary_proxy_delta=(
+                                 args.max_canary_proxy_delta))
     if args.lint_report:
         failures.extend(lint_gate(args.lint_report))
     print(json.dumps({"ok": not failures, "failures": failures,

@@ -76,10 +76,16 @@ def last_run(records):
     over the WHOLE log, not just the last run: resume fallback fires
     BEFORE the resumed run's run_config is written, and a quarantined
     sample is data rot regardless of which restart hit it — the
-    check_regression gate wants the conservative total."""
+    check_regression gate wants the conservative total.
+
+    ``quality`` collects the flow-quality stream
+    (``quality_score``/``quality_drift`` events,
+    ``raft_tpu/obs/quality.py``) over the whole log like ``faults`` —
+    drift that fired before the last restart is still drift."""
     run_cfg, steps, health, spans, costs = None, [], [], [], []
     faults = {"sample_quarantine": 0, "ckpt_fallback": 0,
               "serve_retry": 0, "chaos_inject": 0}
+    quality = {"scores": [], "drifts": []}
     for rec in records:
         ev = rec.get("event")
         if ev == "run_config":
@@ -92,6 +98,10 @@ def last_run(records):
             spans.append(rec)
         elif ev == "cost_report":
             costs.append(rec)
+        elif ev == "quality_score":
+            quality["scores"].append(rec)
+        elif ev == "quality_drift":
+            quality["drifts"].append(rec)
         elif ev == "metrics_summary":
             # The run's final raft_cost_mfu gauge values ride along as
             # a synthetic record so summarize() folds them next to the
@@ -102,7 +112,7 @@ def last_run(records):
                 costs.append({"_mfu_gauge": vals})
         elif ev in faults:
             faults[ev] += 1
-    return run_cfg, steps, health, faults, spans, costs
+    return run_cfg, steps, health, faults, spans, costs, quality
 
 
 def _wait_s(rec):
@@ -181,8 +191,47 @@ def cost_summary(costs, value):
     return out
 
 
+def _pctl(vals, q):
+    vals = sorted(vals)
+    return vals[min(int(len(vals) * q), len(vals) - 1)]
+
+
+def quality_summary(quality):
+    """Fold the flow-quality stream (``quality_score`` /
+    ``quality_drift`` events, raft_tpu/obs/quality.py) into
+    config-block fields: per-proxy p50/p95 over the sampled scores,
+    the drift-event count, and ``quality_drift_score`` — the PEAK PSI
+    score any drift event reported, which is what
+    ``scripts/check_regression.py --max-quality-drift`` gates on.
+    Returns ``{}`` for logs without quality events — old logs
+    summarize unchanged."""
+    if not quality or not (quality.get("scores")
+                           or quality.get("drifts")):
+        return {}
+    per_proxy = {}
+    for rec in quality.get("scores", []):
+        for key in ("photometric", "residual", "cycle"):
+            v = rec.get(key)
+            if isinstance(v, (int, float)) and v >= 0:
+                per_proxy.setdefault(key, []).append(float(v))
+    out = {"quality": {
+        "scored_total": len(quality.get("scores", [])),
+        **{k: {"p50": round(_pctl(v, 0.50), 6),
+               "p95": round(_pctl(v, 0.95), 6), "n": len(v)}
+           for k, v in sorted(per_proxy.items())},
+    }}
+    drifts = quality.get("drifts", [])
+    if drifts:
+        scores = [d.get("score") for d in drifts
+                  if isinstance(d.get("score"), (int, float))]
+        out["quality_drift_events"] = len(drifts)
+        if scores:
+            out["quality_drift_score"] = round(max(scores), 6)
+    return out
+
+
 def summarize(run_cfg, steps, health=None, faults=None, spans=None,
-              costs=None, skip=2):
+              costs=None, quality=None, skip=2):
     if run_cfg is None:
         raise SystemExit("no run_config event in log (telemetry written "
                          "by an older build?) — cannot recover batch "
@@ -234,6 +283,8 @@ def summarize(run_cfg, steps, health=None, faults=None, spans=None,
     health_cfg.update(trace_summary(spans))
     # Cost-model fold (docs/OBSERVABILITY.md "Cost model & roofline").
     health_cfg.update(cost_summary(costs, value))
+    # Flow-quality fold (docs/OBSERVABILITY.md "Flow quality").
+    health_cfg.update(quality_summary(quality))
     last_health = (health or [None])[-1]
     if last_health is not None:
         health_cfg["nonfinite_steps_total"] = last_health.get(
@@ -268,10 +319,10 @@ def summarize(run_cfg, steps, health=None, faults=None, spans=None,
 
 def main(argv=None):
     args = parse_args(argv)
-    run_cfg, steps, health, faults, spans, costs = last_run(
+    run_cfg, steps, health, faults, spans, costs, quality = last_run(
         iter_records(args.path))
     print(json.dumps(summarize(run_cfg, steps, health, faults, spans,
-                               costs, skip=args.skip)))
+                               costs, skip=args.skip, quality=quality)))
 
 
 if __name__ == "__main__":

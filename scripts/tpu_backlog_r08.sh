@@ -1,0 +1,69 @@
+#!/bin/bash
+# Round-8 TPU backlog, priority order: validate the flow-quality
+# observability stack (raft_tpu/obs/quality.py) on hardware and arm
+# the quality gates.  Off-TPU the proxies are calibrated on synthetic
+# fixtures only (tests/test_quality.py); this round measures the
+# sampled-scoring overhead on a real chip, runs the drill at
+# production shapes, stamps the first real-dataset proxy<->EPE
+# Spearman numbers, and turns on --max-quality-drift /
+# --max-canary-proxy-delta for the BENCH series.  Every step is
+# independently resumable.
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+
+# 0. The drill at production scale (NOT --tiny: (64, 96) bucket, an
+#    8-iteration budget): proxy-canary refusal of scrambled weights,
+#    then the chaos-injected hot swap caught by the PSI drift
+#    detector.  The record's quality_drift_score /
+#    canary_proxy_delta_pct are the first hardware data points for
+#    the step-3 gates.
+python scripts/quality_smoke.py 2>&1 \
+    | tee /tmp/quality_smoke_r08.log | tail -1 > QUALITY_SMOKE_r08.json
+
+# 1. Scoring overhead on the serve hot path: A/B the same slot-mode
+#    load at rate 0 vs rate 1.  The photometric program runs off the
+#    iter_step critical path, so p50 latency and pairs/sec should
+#    move < 2%; a bigger delta means the per-retirement host transfer
+#    of delta_max or the scoring program is contending with the
+#    device loop — tune quality_sample_rate DOWN (0.05 is plenty for
+#    drift detection at production request rates) before arming gates.
+python scripts/bench_serve.py --batching slot --shapes 440x1024 \
+    --requests 128 --concurrency 16 \
+    2>&1 | tee /tmp/bench_serve_q0_r08.log | tail -1 > BENCH_SERVE_Q0_r08.json
+python scripts/bench_serve.py --batching slot --shapes 440x1024 \
+    --requests 128 --concurrency 16 --quality-sample-rate 1.0 \
+    2>&1 | tee /tmp/bench_serve_q1_r08.log | tail -1 > BENCH_SERVE_Q1_r08.json
+
+# 2. Real-dataset calibration: proxy<->EPE Spearman on FlyingChairs
+#    with the real checkpoint (weights-blocked off-TPU; see
+#    docs/REAL_WEIGHTS_RUNBOOK.md).  The synthetic-fixture bar is
+#    0.6 for photometric AND residual; record what real data gives —
+#    a proxy that calibrates on synthetic but not on Chairs is not a
+#    trustworthy canary and its gate stays unarmed.
+python -m raft_tpu evaluate --model checkpoints/raft --dataset chairs \
+    --quality-proxies --quality-cycle 2>&1 \
+    | tee /tmp/eval_quality_r08.log | tail -1 > EVAL_QUALITY_r08.json
+
+# 3. Arm the quality gates against the fresh records.  Ceilings are
+#    INTENTIONALLY loose on first arming (2x the drill's healthy-
+#    traffic drift score; canary delta well under the scrambled
+#    blowout but above legitimate-swap noise — see
+#    FleetConfig.canary_proxy_budget): the point this round is that
+#    the gates hold real data.  Both fail vacuously without
+#    qualifying records, so a drill that silently skipped scoring
+#    shows up here, not in a false pass.
+python scripts/check_regression.py \
+    --max-quality-drift 2.5 --max-canary-proxy-delta 1000 \
+    2>&1 | tail -3
+
+# 4. Drift soak under traced load: sampled scoring + drift detectors
+#    live for a longer slot-mode run, then the telemetry fold — the
+#    summary's quality block (per-proxy p50/p95, drift events) and
+#    trace spans carrying quality_photometric attrs come from the
+#    same stream, so slow AND bad requests correlate per trace tree.
+RAFT_TRACE_SAMPLE_RATE=0.1 RAFT_TELEMETRY_DIR=/tmp/telem_r08 \
+    python scripts/bench_serve.py --batching slot --shapes 440x1024 \
+    --requests 256 --concurrency 8 --quality-sample-rate 0.25 \
+    2>&1 | tail -1
+python scripts/telemetry_summary.py /tmp/telem_r08 2>&1 | tail -1
+python scripts/trace_report.py /tmp/telem_r08 2>&1 | tail -20

@@ -364,6 +364,35 @@ def test_rolling_update_flips_and_gates(variables, aot_dir):
         fleet.stop()
 
 
+def test_scrambled_weights_refused_by_proxy_canary(variables, aot_dir):
+    """Finite-but-garbage weights (every param scaled x25) sail through
+    the shape+finiteness canary — the flow is the right shape and all
+    finite, just wild — and are refused at the golden-batch quality
+    proxy gate instead (``FleetConfig.canary_proxy_budget``).  The
+    version stays put and the fleet keeps serving the old weights."""
+    import jax
+
+    scrambled = jax.tree_util.tree_map(
+        lambda x: np.asarray(x) * 25.0, jax.device_get(variables))
+    fleet = _mk_fleet(variables, aot_dir)
+    fleet.start()
+    try:
+        router = FlowRouter(fleet, RouterConfig())
+        rng = np.random.default_rng(11)
+        im1, im2 = _images(rng)
+        before = router.infer(im1, im2, timeout=120)
+        version0 = fleet.weights_version
+        with pytest.raises(WeightUpdateError, match="proxy"):
+            fleet.update_weights(scrambled)
+        assert fleet.weights_version == version0
+        after = router.infer(im1, im2, timeout=120)
+        assert np.allclose(after, before), \
+            "refused update changed what the fleet serves"
+        assert fleet.health()["ready"]
+    finally:
+        fleet.stop()
+
+
 def test_fleet_stop_during_update_warmup_joins_cleanly(variables,
                                                       aot_dir):
     """``fleet.stop(drain=True)`` racing a rolling update's warmup must

@@ -306,8 +306,14 @@ def test_evaluate_early_exit_delta_record(variables, monkeypatch):
     assert base["iters_p50"] == float(ITERS)
     for arm in rec["per_threshold"].values():
         assert set(arm) == {"epe", "epe_delta", "iters_mean",
-                            "iters_p50", "iters_p95"}
+                            "iters_p50", "iters_p95",
+                            "residual_mean", "residual_p50"}
         assert np.isfinite(arm["epe"])
+        # Retirement residual: delta_max is a max of norms, so any lane
+        # that ran >= 1 iteration carries a value >= 0 (never the -1
+        # "untouched" sentinel).
+        assert arm["residual_mean"] >= 0.0
+        assert arm["residual_p50"] >= 0.0
     # Monotone: larger threshold can only retire earlier.
     p50s = [rec["per_threshold"][k]["iters_p50"]
             for k in rec["thresholds"]]
