@@ -12,6 +12,19 @@ flow is float32 and PNG-style encodings lose the sign/scale):
   ``Retry-After`` header plus a structured JSON body
   ``{"error", "queue_depth", "retry_after_s"}`` so clients can back
   off programmatically; 400 on malformed input.
+- ``POST /v1/stream/{id}``  streaming video sessions
+  (docs/SERVING.md "Streaming sessions"): body =
+  ``np.savez(buf, image=...)`` with ONE ``(H, W, 3)`` frame.  The
+  first POST for an unknown ``{id}`` opens the session (frame 0, no
+  flow yet; optional query params ``iters`` and ``ttl_s``) and
+  returns ``npz`` with ``frame=0``; every later POST returns ``npz``
+  with ``flow`` (previous frame -> this frame), ``frame``, and
+  ``warm`` (whether the warm-start fast path served it).  429/400 as
+  above; 409 when the session already has a frame in flight.
+- ``DELETE /v1/stream/{id}``  close the session; JSON summary
+  ``{"session", "frames", "pairs", "warm_pairs"}``.  404 on unknown
+  (or already-expired) ids — idle sessions self-evict after their
+  TTL.
 
 With ``--replicas N`` (N > 1) the same endpoints front a supervised
 replica fleet (``raft_tpu/serve/fleet.py``): requests route through a
@@ -96,6 +109,18 @@ def parse_args(argv=None):
     p.add_argument("--slots", type=int, default=8,
                    help="slot mode: persistent device lanes per bucket "
                         "(tunable via scripts/autotune.py --kind serve)")
+    p.add_argument("--stream-ttl-s", type=float, default=60.0,
+                   help="streaming sessions: evict a session (and free "
+                        "its pinned lane) after this long without a "
+                        "frame (docs/SERVING.md 'Streaming sessions')")
+    p.add_argument("--stream-warm-iters", type=int, default=None,
+                   help="streaming sessions: iteration budget for "
+                        "warm-started frames (default: the session's "
+                        "budget; warm frames also early-exit sooner "
+                        "under --early-exit-threshold)")
+    p.add_argument("--max-sessions", type=int, default=64,
+                   help="open streaming sessions bound; beyond it "
+                        "session opens get 429")
     p.add_argument("--early-exit-threshold", type=float, default=0.0,
                    help="slot mode: retire a request when its max flow "
                         "update falls below this (0 = always run the "
@@ -236,11 +261,86 @@ def _make_handler(engine):
             else:
                 self._reply_json(404, {"error": f"no route {self.path}"})
 
+        def do_DELETE(self):
+            if not self.path.startswith("/v1/stream/"):
+                self._reply_json(404, {"error": f"no route {self.path}"})
+                return
+            sid = self.path[len("/v1/stream/"):]
+            try:
+                summary = engine.stream_close(sid)
+            except ValueError as e:
+                code = 404 if "unknown session" in str(e) else 409
+                self._reply_json(code, {"error": str(e)})
+                return
+            except Exception as e:
+                self._reply_json(
+                    500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._reply_json(200, summary)
+
+        def _stream(self):
+            """POST /v1/stream/{id} — open-on-first-use streaming
+            frame (module docstring has the wire protocol)."""
+            import numpy as np
+
+            from urllib.parse import parse_qs, urlparse
+
+            u = urlparse(self.path)
+            sid = u.path[len("/v1/stream/"):]
+            if not sid or "/" in sid:
+                self._reply_json(404, {"error": f"no route {u.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                with np.load(io.BytesIO(self.rfile.read(n))) as z:
+                    image = z["image"]
+                qs = parse_qs(u.query)
+                iters = (int(qs["iters"][0])
+                         if "iters" in qs else None)
+                ttl_s = (float(qs["ttl_s"][0])
+                         if "ttl_s" in qs else None)
+            except Exception as e:
+                self._reply_json(400, {"error": f"bad stream "
+                                                f"request: {e}"})
+                return
+            try:
+                out = engine.stream_ingest(sid, image, iters=iters,
+                                           ttl_s=ttl_s)
+            except QueueFullError as e:
+                retry_s = float(getattr(e, "retry_after_s", 1.0))
+                self._reply_json(
+                    429, {"error": str(e),
+                          "queue_depth": int(getattr(e, "queue_depth",
+                                                     0)),
+                          "retry_after_s": retry_s},
+                    extra=[("Retry-After",
+                            str(max(1, math.ceil(retry_s))))])
+                return
+            except ValueError as e:
+                code = 409 if "in flight" in str(e) else 400
+                self._reply_json(code, {"error": str(e)})
+                return
+            except Exception as e:
+                self._reply_json(
+                    500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            buf = io.BytesIO()
+            if out["flow"] is None:
+                np.savez(buf, frame=out["frame"], warm=False)
+            else:
+                np.savez(buf, flow=out["flow"], frame=out["frame"],
+                         warm=out["warm"])
+            self._reply(200, buf.getvalue(),
+                        "application/octet-stream")
+
         def do_POST(self):
             import numpy as np
 
             if self.path.startswith("/debug/profile"):
                 self._profile()
+                return
+            if self.path.startswith("/v1/stream/"):
+                self._stream()
                 return
             if self.path != "/v1/flow":
                 self._reply_json(404, {"error": f"no route {self.path}"})
@@ -409,6 +509,9 @@ def main(argv=None):
     serve_cfg = ServeConfig(
         iters=args.iters, batching=args.batching, slots=args.slots,
         early_exit_threshold=max(args.early_exit_threshold, 0.0),
+        stream_ttl_s=max(args.stream_ttl_s, 1e-3),
+        stream_warm_iters=args.stream_warm_iters,
+        max_sessions=max(args.max_sessions, 1),
         max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
         buckets=_parse_hw_list(args.buckets) if args.buckets else None,

@@ -307,6 +307,36 @@ def _make_encoders(cfg: RAFTConfig):
     return fnet, cnet
 
 
+def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2):
+    """Correlation state from a pair of fp32 feature maps, dispatched on
+    ``corr_impl``.  Shared by the cold encode (:func:`_encode_state`)
+    and the streaming warm encode (:class:`RAFTEncodeWarm`, which feeds
+    a *carried* ``fmap1`` from the previous frame), so the corr-state
+    pytree structure cannot drift between the two admit programs."""
+    corr_impl = cfg.resolved_corr_impl
+    if corr_impl == "allpairs":
+        # corr_dtype (storage) applies here too: the XLA lookup
+        # re-accumulates fp32 in _sample_windows regardless.
+        return build_corr_pyramid(
+            fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
+            out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
+    if corr_impl == "allpairs_pallas":
+        return build_corr_pyramid_flat(
+            fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
+            pad_q=cfg.lookup_block_q,
+            out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
+    if corr_impl in ("chunked", "pallas"):
+        if cfg.corr_dtype_is_quantized:
+            raise ValueError(
+                f"corr_dtype={cfg.resolved_corr_dtype!r} requires a "
+                "materialized pyramid (corr_impl 'allpairs' or "
+                "'allpairs_pallas'); the on-demand "
+                f"{corr_impl!r} path never stores the volume, so "
+                "there is nothing to quantize")
+        return (fmap1, pool_fmap_pyramid(fmap2, cfg.corr_levels))
+    raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")
+
+
 def _encode_state(cfg: RAFTConfig, fnet, cnet, image1, image2, train,
                   freeze_bn, flow_init=None):
     """The pre-scan half of the forward pass: normalize → shared-weight
@@ -328,29 +358,7 @@ def _encode_state(cfg: RAFTConfig, fnet, cnet, image1, image2, train,
     fmap1 = fmaps[:B].astype(jnp.float32)
     fmap2 = fmaps[B:].astype(jnp.float32)
 
-    corr_impl = cfg.resolved_corr_impl
-    if corr_impl == "allpairs":
-        # corr_dtype (storage) applies here too: the XLA lookup
-        # re-accumulates fp32 in _sample_windows regardless.
-        corr_state = build_corr_pyramid(
-            fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
-            out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
-    elif corr_impl == "allpairs_pallas":
-        corr_state = build_corr_pyramid_flat(
-            fmap1, fmap2, cfg.corr_levels, cfg.resolved_corr_precision,
-            pad_q=cfg.lookup_block_q,
-            out_dtype=jnp.dtype(cfg.resolved_corr_dtype))
-    elif corr_impl in ("chunked", "pallas"):
-        if cfg.corr_dtype_is_quantized:
-            raise ValueError(
-                f"corr_dtype={cfg.resolved_corr_dtype!r} requires a "
-                "materialized pyramid (corr_impl 'allpairs' or "
-                "'allpairs_pallas'); the on-demand "
-                f"{corr_impl!r} path never stores the volume, so "
-                "there is nothing to quantize")
-        corr_state = (fmap1, pool_fmap_pyramid(fmap2, cfg.corr_levels))
-    else:
-        raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")
+    corr_state = _build_corr_state(cfg, fmap1, fmap2)
 
     ctx = cnet(image1.astype(dt), train, freeze_bn)
     net = jnp.tanh(ctx[..., :hdim])
@@ -576,7 +584,7 @@ class RAFT(nn.Module):
 # two separately-jitted programs instead of one: ``encode`` (everything
 # before the refinement scan) and one refinement iteration at a time
 # (so requests can join/leave the device batch between iterations, and
-# converged samples can exit early).  The three modules below bind the
+# converged samples can exit early).  The modules below bind the
 # SAME parameter scopes as :class:`RAFT` — ``fnet``/``cnet``/``refine``/
 # ``upsampler`` — so a variables tree from ``RAFT.init`` (or any
 # checkpoint) applies unchanged; extra subtrees a given program does not
@@ -610,6 +618,71 @@ class RAFTEncode(nn.Module):
         fnet, cnet = _make_encoders(self.config)
         return _encode_state(self.config, fnet, cnet, image1, image2,
                              False, False, flow_init)
+
+
+class RAFTFrameFeatures(nn.Module):
+    """Single-frame feature stash for streaming sessions:
+    ``image -> (fmap (fp32), ctx (model dtype))``.
+
+    A streamed pair shares its first frame with the previous pair's
+    second frame (consecutive-frame identity), so serving carries that
+    frame's feature map AND its raw context-encoder output
+    device-resident in the lane.  ``fmap`` feeds the next pair's corr
+    build as ``fmap1``; ``ctx`` is split tanh/relu into ``net``/``inp``
+    at warm-encode time (the split is cheap, the conv stack is not).
+    Binds the same ``fnet``/``cnet`` scopes as :class:`RAFT`, inference
+    mode, identical normalization to :func:`_encode_state`."""
+
+    config: RAFTConfig = RAFTConfig()
+
+    @nn.compact
+    def __call__(self, image):
+        cfg = self.config
+        fnet, cnet = _make_encoders(cfg)
+        image = 2.0 * (image.astype(jnp.float32) / 255.0) - 1.0
+        fmap = fnet(image.astype(cfg.dtype), False, False)
+        ctx = cnet(image.astype(cfg.dtype), False, False)
+        return fmap.astype(jnp.float32), ctx
+
+
+class RAFTEncodeWarm(nn.Module):
+    """Warm-start encode for streamed frame N+1: only the NEW frame
+    runs through the feature encoder — the carried previous-frame
+    features stand in for frame 1 of the pair.
+
+    ``(image2, fmap1, ctx1, flow_init) -> (net, inp, coords0, coords1,
+    corr_state, fmap2, ctx2)`` where ``fmap1``/``ctx1`` are the carry
+    stashed when the previous frame was encoded (its ``fmap2``/its
+    :class:`RAFTFrameFeatures` ctx), ``flow_init`` is the previous
+    pair's forward-warped flow (added to the ``coords1`` grid exactly
+    like :func:`_encode_state`), and the returned ``fmap2``/``ctx2``
+    are the NEXT carry.  Per warm frame the encoders run once each
+    (fnet + cnet on the new frame) versus three conv-stack passes for a
+    cold pair (fnet twice + cnet) — the fnet work per frame is halved,
+    which the cost model exposes as ``wenc`` vs ``enc``
+    flops-per-pair."""
+
+    config: RAFTConfig = RAFTConfig()
+
+    @nn.compact
+    def __call__(self, image2, fmap1, ctx1, flow_init):
+        cfg = self.config
+        hdim = cfg.hidden_dim
+        fnet, cnet = _make_encoders(cfg)
+        image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
+        fmap2 = fnet(image2.astype(cfg.dtype), False, False)
+        ctx2 = cnet(image2.astype(cfg.dtype), False, False)
+        fmap2 = fmap2.astype(jnp.float32)
+
+        corr_state = _build_corr_state(cfg, fmap1, fmap2)
+
+        net = jnp.tanh(ctx1[..., :hdim])
+        inp = nn.relu(ctx1[..., hdim:])
+
+        B, H8, W8, _ = fmap1.shape
+        coords0 = coords_grid(B, H8, W8)
+        coords1 = coords_grid(B, H8, W8) + flow_init
+        return net, inp, coords0, coords1, corr_state, fmap2, ctx2
 
 
 class RAFTIterStep(nn.Module):

@@ -83,6 +83,61 @@ def bilinear_sampler(img: jax.Array, coords: jax.Array,
     return out
 
 
+def forward_warp_flow(flow: jax.Array, eps: float = 1e-3) -> jax.Array:
+    """Forward-warp (bilinear-splat) a flow field onto the next frame.
+
+    RAFT's warm start initializes the next pair's ``coords1`` from the
+    previous pair's flow *advected by itself*: the pixel at ``x`` with
+    flow ``f(x)`` lands at ``x + f(x)`` in the next frame and carries
+    its motion estimate along.  The reference demo does this on the
+    host (``forward_interpolate``, scipy griddata); this is the
+    on-device equivalent — each source pixel splats its flow vector
+    into the four integer neighbours of its landing site with bilinear
+    weights, splats are accumulated (scatter-add) and normalized by
+    the accumulated weight.  Targets nobody lands on (dis-occlusions)
+    get exactly the cold-start init of zero flow.
+
+    Args:
+      flow: ``(B, H, W, 2)`` flow field, last axis ``(x, y)``.
+      eps: weight floor below which a target counts as unhit.
+
+    Returns:
+      ``(B, H, W, 2)`` forward-warped flow.
+    """
+    B, H, W, C = flow.shape
+    base = coords_grid(B, H, W, dtype=flow.dtype)
+    tgt = base + flow
+    x = tgt[..., 0].reshape(B, -1)
+    y = tgt[..., 1].reshape(B, -1)
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+    vals = flow.reshape(B, -1, C)
+    idxs, payloads = [], []
+    for dx, dy, w in ((0, 0, (1 - wx) * (1 - wy)),
+                      (1, 0, wx * (1 - wy)),
+                      (0, 1, (1 - wx) * wy),
+                      (1, 1, wx * wy)):
+        ix = x0 + dx
+        iy = y0 + dy
+        valid = ((ix >= 0) & (ix <= W - 1) & (iy >= 0)
+                 & (iy <= H - 1)).astype(flow.dtype)
+        w = w * valid
+        idxs.append((jnp.clip(iy, 0, H - 1) * W
+                     + jnp.clip(ix, 0, W - 1)).astype(jnp.int32))
+        payloads.append(
+            jnp.concatenate([vals * w[..., None], w[..., None]], axis=-1))
+    idx = jnp.concatenate(idxs, axis=1)        # (B, 4*H*W)
+    val = jnp.concatenate(payloads, axis=1)    # (B, 4*H*W, C+1)
+    acc = jax.vmap(
+        lambda i, v: jnp.zeros((H * W, C + 1), flow.dtype).at[i].add(v)
+    )(idx, val)
+    den = acc[..., C:]
+    out = jnp.where(den > eps, acc[..., :C] / jnp.maximum(den, eps), 0.0)
+    return out.reshape(B, H, W, C)
+
+
 @functools.lru_cache(maxsize=64)
 def _interp_matrix(src: int, dst: int) -> "np.ndarray":
     """Dense ``(dst, src)`` align_corners=True bilinear interpolation matrix.

@@ -57,9 +57,25 @@ beyond it raises :class:`QueueFullError` (the HTTP layer maps it to 429)
 — the queue can never grow without bound, and latency under overload
 stays bounded instead of collapsing.
 
+4. **Streaming sessions** (``stream_open`` / ``stream_submit`` /
+   ``stream_close``; ``POST /v1/stream/{id}`` at the HTTP layer).  A
+   session pins one lane of its bucket's slot pool across frames: frame
+   N+1 uploads only the NEW image — the previous frame's flow is
+   forward-warped on-device into the lane's ``coords1`` init and the
+   previous frame's feature map / context are reused as the next pair's
+   frame-1 features (consecutive-frame identity), so a warm frame runs
+   the feature encoder once instead of twice and starts its GRU
+   iterations near the answer.  A session's FIRST pair goes through the
+   unmodified ``encode_admit`` program (bit-identical to the stateless
+   slot path); idle sessions are evicted back to the free pool after
+   ``stream_ttl_s``.  See docs/SERVING.md "Streaming sessions".
+
 Scope: single-host, single-device per engine (multi-chip serving is one
-engine process per chip behind an external balancer); requests are
-stateless frame pairs (no cross-request warm start).
+engine process per chip behind an external balancer).  Stateless
+``submit()`` requests stay independent frame pairs; cross-request warm
+start exists ONLY inside an explicit streaming session, whose device
+state dies with the engine (rolling weight updates and replica failover
+restart streams cold — the router re-seeds them, docs/SERVING.md).
 """
 
 from __future__ import annotations
@@ -166,7 +182,17 @@ class ServeConfig:
     sampled forward-backward cycle-consistency pass (one extra
     inference on the swapped frames per scored request).  The
     ``quality_drift_*`` knobs size the PSI drift detector (reference
-    sample count, rolling window, firing threshold)."""
+    sample count, rolling window, firing threshold).
+    Streaming-session knobs (slot mode only — docs/SERVING.md
+    "Streaming sessions"): ``stream_ttl_s`` evicts a session whose
+    client went quiet back to the free pool (its pinned lane is what
+    the TTL protects); ``stream_warm_iters`` is the per-frame
+    iteration budget for WARM-started frames (``None`` keeps the
+    session budget — warm frames then rely on ``early_exit_threshold``
+    to retire early; set it below ``iters`` to cap warm frames
+    outright, the streaming policy RAFT's warm-start convergence
+    buys); ``max_sessions`` bounds the open-session registry (opens
+    beyond it are rejected 429-style)."""
 
     iters: int = 32
     max_batch: int = 8
@@ -195,8 +221,19 @@ class ServeConfig:
     quality_drift_reference: int = 256
     quality_drift_window: int = 64
     quality_drift_threshold: float = 0.5
+    stream_ttl_s: float = 60.0
+    stream_warm_iters: Optional[int] = None
+    max_sessions: int = 64
 
     def __post_init__(self):
+        if self.stream_ttl_s <= 0:
+            raise ValueError("stream_ttl_s must be > 0")
+        if self.stream_warm_iters is not None \
+                and self.stream_warm_iters < 1:
+            raise ValueError("stream_warm_iters must be >= 1 (None "
+                             "keeps the session budget)")
+        if self.max_sessions < 1:
+            raise ValueError("max_sessions must be >= 1")
         if self.max_batch < 1 or self.max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         if self.batching not in ("request", "slot"):
@@ -254,9 +291,10 @@ class ServeConfig:
 
 class _Request:
     __slots__ = ("image1", "image2", "bucket", "padder", "future",
-                 "t_submit", "trace", "iters")
+                 "t_submit", "trace", "iters", "session", "warm")
 
-    def __init__(self, image1, image2, bucket, padder, iters=None):
+    def __init__(self, image1, image2, bucket, padder, iters=None,
+                 session=None, warm=False):
         self.image1 = image1
         self.image2 = image2
         self.bucket = bucket
@@ -264,6 +302,12 @@ class _Request:
         # Per-request iteration budget (slot mode honors it, capped at
         # cfg.iters; request mode runs the full cfg.iters in lockstep).
         self.iters = iters
+        # Streaming-session frame: ``session`` pins the request to the
+        # session's lane, ``warm`` selects the warm-encode admit (carry
+        # + forward-warped flow init) over the cold one.  Stateless
+        # requests carry (None, False) and behave exactly as before.
+        self.session = session
+        self.warm = warm
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         # Trace context captured on the SUBMITTING thread (the router's
@@ -275,6 +319,40 @@ class _Request:
         self.trace = trace.current()
 
 
+class _StreamSession:
+    """One streaming session's host-side record.  Device state (coords,
+    carry) lives in the pinned lane; this object holds the bookkeeping
+    the client API and the TTL sweep need.  Field ownership: created by
+    ``stream_open`` (caller thread); ``lane`` is written only by the
+    device worker at first-pair admission; ``inflight``/``t_last`` are
+    written by ``stream_submit`` under the engine's sessions lock and
+    cleared by the future's done callback; ``carry_ok`` flips on the
+    device worker (stash/warm-encode success or failure)."""
+
+    __slots__ = ("sid", "bucket", "padder", "shape", "iters", "ttl_s",
+                 "lane", "frames", "pairs", "warm_pairs", "last_image",
+                 "inflight", "t_last", "t_open", "carry_ok", "closed")
+
+    def __init__(self, sid, bucket, padder, shape, iters, ttl_s,
+                 first_image):
+        self.sid = sid
+        self.bucket = bucket
+        self.padder = padder
+        self.shape = shape
+        self.iters = iters
+        self.ttl_s = ttl_s
+        self.lane: Optional[int] = None
+        self.frames = 1          # the opening frame
+        self.pairs = 0           # pairs retired successfully
+        self.warm_pairs = 0
+        self.last_image = first_image
+        self.inflight: Optional[Future] = None
+        self.t_last = time.time()
+        self.t_open = self.t_last
+        self.carry_ok = False    # device carry (fmap/ctx) is valid
+        self.closed = False
+
+
 class _Programs:
     """One ``(bucket, lanes)``'s compiled ``encode``/``iter_step`` pair
     plus cached call constants: the all-zeros device-resident state the
@@ -284,7 +362,8 @@ class _Programs:
     so one ``_Programs`` serves every batch of its shape."""
 
     __slots__ = ("enc", "it", "template", "state0", "mask_all",
-                 "budget_full", "thr_off", "bucket", "lanes")
+                 "budget_full", "thr_off", "bucket", "lanes", "wenc",
+                 "stash", "carry0")
 
     def __init__(self, enc, it, template, bucket, lanes, full_iters):
         self.enc = enc
@@ -296,6 +375,12 @@ class _Programs:
         self.thr_off = np.float32(0.0)
         self.bucket = bucket
         self.lanes = lanes
+        # Streaming programs (warm encode / carry stash) + the zero
+        # carry: compiled lazily by _get_stream_programs on the first
+        # streamed pair — non-streaming engines never build them.
+        self.wenc = None
+        self.stash = None
+        self.carry0 = None
 
 
 class _SlotPool:
@@ -305,7 +390,7 @@ class _SlotPool:
     the device-worker call it awaits, so it needs no locking."""
 
     __slots__ = ("progs", "state", "reqs", "budgets", "active_np",
-                 "t_admit")
+                 "t_admit", "carry", "pins")
 
     def __init__(self, slots: int):
         self.progs: Optional[_Programs] = None
@@ -314,17 +399,30 @@ class _SlotPool:
         self.budgets = np.zeros((slots,), np.int32)
         self.active_np = np.zeros((slots,), bool)
         self.t_admit = [0.0] * slots
+        # Streaming: device-resident carry pytree (previous frame's
+        # fmap/ctx per lane) and the lane -> session pin map.  A pinned
+        # lane is excluded from regular admission even while idle — its
+        # coords/carry are the session's warm-start state.
+        self.carry = None
+        self.pins: Dict[int, _StreamSession] = {}
 
     def live(self) -> List[_Request]:
         return [r for r in self.reqs if r is not None]
 
     def reset(self) -> None:
+        """Zero the device state after a failed cycle.  Pinned sessions
+        stay pinned but their warm-start state is gone — the caller
+        marks them cold (``carry_ok = False``) so their next frame
+        re-seeds through the cold path."""
         slots = len(self.reqs)
         self.reqs = [None] * slots
         self.budgets = np.zeros((slots,), np.int32)
         self.active_np = np.zeros((slots,), bool)
         if self.progs is not None:
             self.state = self.progs.state0
+            self.carry = self.progs.carry0
+        for s in self.pins.values():
+            s.carry_ok = False
 
 
 class InferenceEngine:
@@ -371,6 +469,13 @@ class InferenceEngine:
         self._encode_jit = jax.jit(slots_mod.make_encode_fn(
             self._model_cfg))
         self._iter_jit = jax.jit(slots_mod.make_iter_fn(self._model_cfg))
+        # Streaming-session programs (warm encode + carry stash): jit
+        # wrappers are cheap to build; nothing traces or compiles until
+        # the first streamed pair (_get_stream_programs).
+        self._warm_jit = jax.jit(slots_mod.make_warm_encode_fn(
+            self._model_cfg))
+        self._stash_jit = jax.jit(slots_mod.make_stash_fn(
+            self._model_cfg))
         # Keep params resident on device: the executable is called with
         # this exact pytree every batch, so requests never re-upload it.
         self._variables = jax.device_put(variables)
@@ -423,7 +528,36 @@ class InferenceEngine:
             help="refinement iterations a request consumed before "
                  "retiring (early exit / per-request budget)",
             scale=1.0, suffix="")
+        # Warm/cold split of the same observation: every retirement
+        # lands in ONE of these two plus the combined histogram above,
+        # so cold-vs-warm convergence is separable downstream
+        # (telemetry_summary.py warm_iters_saved_frac).
+        self._iters_used_warm = LatencyRecorder(
+            cfg.latency_window, registry=self.registry,
+            metric="raft_serve_iters_used_warm",
+            help="iterations consumed by warm-started streamed frames",
+            scale=1.0, suffix="")
+        self._iters_used_cold = LatencyRecorder(
+            cfg.latency_window, registry=self.registry,
+            metric="raft_serve_iters_used_cold",
+            help="iterations consumed by cold-started requests "
+                 "(stateless pairs and session first pairs)",
+            scale=1.0, suffix="")
         self._counters = Counters(registry=self.registry)
+        # Streaming-session registry: sid -> _StreamSession.  Guarded
+        # by _sessions_lock (caller threads open/submit/close; the
+        # device worker pins lanes and the TTL sweep evicts).
+        self._sessions: Dict[str, _StreamSession] = {}
+        self._sessions_lock = threading.Lock()
+        self._sessions_gauge = self.registry.gauge(
+            "raft_serve_sessions_open",
+            "streaming sessions currently open")
+        self._stream_frames = self.registry.counter(
+            "raft_serve_stream_frames_total",
+            "streamed frames received (session mode)")
+        self._stream_evicted = self.registry.counter(
+            "raft_serve_stream_evictions_total",
+            "streaming sessions evicted by the idle TTL")
         # Flow-quality scoring (obs/quality.py): built ONLY when the
         # sample rate is nonzero — at 0 the hot path carries no
         # monitor, no extra device fetch in _iter_slots, and no
@@ -674,6 +808,227 @@ class InferenceEngine:
         return self.submit(image1, image2,
                            iters=iters).result(timeout=timeout)
 
+    # ------------------------------------------------------------------
+    # client API — streaming sessions (any thread)
+    # ------------------------------------------------------------------
+
+    def _check_accepting(self) -> None:
+        if self._accepting:
+            return
+        if self.crashed:
+            raise RuntimeError(f"engine crashed: {self.crashed}")
+        if self._stopped:
+            raise RuntimeError(
+                "engine stopped — engines are single-use; build a "
+                "new InferenceEngine or route to a live replica")
+        raise RuntimeError("engine not started (or stopping)")
+
+    def stream_open(self, session_id: str, image, *,
+                    iters: Optional[int] = None,
+                    ttl_s: Optional[float] = None) -> dict:
+        """Open a streaming session seeded with its first frame.
+
+        No device work happens here — the frame is held host-side; the
+        first :meth:`stream_submit` forms the session's first (cold)
+        pair, which pins a lane in the bucket's slot pool.  ``iters``
+        is the per-session refinement budget (capped at ``cfg.iters``);
+        ``ttl_s`` overrides ``cfg.stream_ttl_s``.  Raises
+        :class:`QueueFullError` when ``max_sessions`` sessions are
+        already open (after sweeping expired ones)."""
+        self._check_accepting()
+        if self.cfg.batching != "slot":
+            raise ValueError(
+                "streaming sessions require batching='slot' (a session "
+                "is a pinned lane in the slot pool)")
+        if iters is not None and int(iters) < 1:
+            raise ValueError(f"iters must be >= 1, got {iters}")
+        if ttl_s is not None and float(ttl_s) <= 0:
+            raise ValueError(f"ttl_s must be > 0, got {ttl_s}")
+        im = np.asarray(image, dtype=np.float32)
+        if im.ndim != 3 or im.shape[-1] != 3:
+            raise ValueError(f"expected an (H, W, 3) image, got "
+                             f"{im.shape}")
+        h, w = im.shape[:2]
+        bucket = bucket_hw(h, w, self.cfg.bucket_multiple,
+                           self.cfg.buckets)
+        padder = InputPadder((h, w), mode=self.cfg.pad_mode,
+                             target=bucket)
+        sess = _StreamSession(
+            str(session_id), bucket, padder, (h, w),
+            None if iters is None else int(iters),
+            float(ttl_s) if ttl_s is not None else self.cfg.stream_ttl_s,
+            im)
+        with self._sessions_lock:
+            evicted = self._sweep_unpinned_locked(time.time())
+            if sess.sid in self._sessions:
+                raise ValueError(f"session {sess.sid!r} already open")
+            if len(self._sessions) >= self.cfg.max_sessions:
+                self._counters.add_rejected()
+                raise QueueFullError(
+                    f"{len(self._sessions)} sessions open >= "
+                    f"max_sessions={self.cfg.max_sessions}",
+                    queue_depth=len(self._sessions),
+                    retry_after_s=self.cfg.retry_after_s)
+            self._sessions[sess.sid] = sess
+            self._sessions_gauge.set(len(self._sessions))
+        self._emit_evictions(evicted)
+        self._sink.emit("stream_open", sid=sess.sid,
+                        bucket=f"{bucket[0]}x{bucket[1]}",
+                        iters=sess.iters, ttl_s=sess.ttl_s)
+        return {"session": sess.sid, "frame": 0,
+                "bucket": list(bucket)}
+
+    def stream_submit(self, session_id: str, image) -> Future:
+        """Stream the next frame into an open session; returns a Future
+        resolving to the flow from the PREVIOUS frame to this one.
+
+        Only this one new image crosses the wire/PCIe: the previous
+        frame's features and flow are already device-resident in the
+        session's lane (warm path) or held host-side for the first
+        pair (cold path).  One frame may be in flight per session —
+        streaming is ordered by construction."""
+        self._check_accepting()
+        im = np.asarray(image, dtype=np.float32)
+        with self._sessions_lock:
+            sess = self._sessions.get(str(session_id))
+            if sess is None:
+                raise ValueError(f"unknown session {session_id!r} "
+                                 "(expired, closed, or never opened)")
+            if im.shape != sess.last_image.shape:
+                raise ValueError(
+                    f"frame shape {im.shape} != session shape "
+                    f"{sess.last_image.shape} (a session is fixed to "
+                    "one resolution)")
+            if sess.inflight is not None and not sess.inflight.done():
+                raise ValueError(
+                    f"session {sess.sid!r} already has a frame in "
+                    "flight (stream frames sequentially)")
+            warm = sess.carry_ok
+            budget = sess.iters
+            if warm and self.cfg.stream_warm_iters is not None:
+                budget = self.cfg.stream_warm_iters
+            with self._pending_lock:
+                if self._pending >= self.cfg.max_queue:
+                    self._counters.add_rejected()
+                    raise QueueFullError(
+                        f"{self._pending} requests in flight >= "
+                        f"max_queue={self.cfg.max_queue}; retry after "
+                        f"{self.cfg.retry_after_s:g}s",
+                        queue_depth=self._pending,
+                        retry_after_s=self.cfg.retry_after_s)
+                if self._pending == 0:
+                    self._pending_since = time.perf_counter()
+                self._pending += 1
+            req = _Request(sess.last_image, im, sess.bucket, sess.padder,
+                           None if budget is None else int(budget),
+                           session=sess, warm=warm)
+            sess.last_image = im
+            sess.frames += 1
+            sess.t_last = time.time()
+            sess.inflight = req.future
+            # Stamped for the blocking facades (stream_ingest / the
+            # HTTP route): which pair this Future resolves and whether
+            # it took the warm path — decided here, race-free.
+            req.future.stream_frame = sess.frames - 1
+            req.future.stream_warm = warm
+            self._stream_frames.inc()
+
+        def _clear_inflight(_fut, sess=sess):
+            with self._sessions_lock:
+                if sess.inflight is _fut:
+                    sess.inflight = None
+                sess.t_last = time.time()
+
+        req.future.add_done_callback(_clear_inflight)
+        try:
+            self._loop.call_soon_threadsafe(self._enqueue, req)
+        except RuntimeError:  # loop closed under our feet (stop race)
+            with self._pending_lock:
+                self._pending -= 1
+            raise RuntimeError("engine stopped")
+        return req.future
+
+    def stream_frame(self, session_id: str, image,
+                     timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience wrapper around :meth:`stream_submit`."""
+        return self.stream_submit(session_id,
+                                  image).result(timeout=timeout)
+
+    def stream_ingest(self, session_id: str, image, *,
+                      iters: Optional[int] = None,
+                      ttl_s: Optional[float] = None,
+                      timeout: Optional[float] = None) -> dict:
+        """Open-on-first-use blocking facade (the ``POST
+        /v1/stream/{id}`` semantics): an unknown session id opens the
+        session with ``image`` as frame 0 (``flow=None``); a known one
+        streams the frame and blocks for its flow.  Returns
+        ``{"session", "frame", "warm", "flow"}``."""
+        sid = str(session_id)
+        with self._sessions_lock:
+            known = sid in self._sessions
+        if not known:
+            ack = self.stream_open(sid, image, iters=iters,
+                                   ttl_s=ttl_s)
+            return {"session": sid, "frame": ack["frame"],
+                    "warm": False, "flow": None}
+        fut = self.stream_submit(sid, image)
+        flow = fut.result(timeout=timeout)
+        return {"session": sid, "frame": fut.stream_frame,
+                "warm": fut.stream_warm, "flow": flow}
+
+    def stream_close(self, session_id: str) -> dict:
+        """Close a session and release its registry entry; the pinned
+        lane returns to the free pool at the dispatcher's next sweep.
+        Returns the session summary."""
+        with self._sessions_lock:
+            sess = self._sessions.get(str(session_id))
+            if sess is None:
+                raise ValueError(f"unknown session {session_id!r} "
+                                 "(expired, closed, or never opened)")
+            if sess.inflight is not None and not sess.inflight.done():
+                raise ValueError(
+                    f"session {sess.sid!r} has a frame in flight — "
+                    "wait for it before closing")
+            del self._sessions[sess.sid]
+            sess.closed = True
+            self._sessions_gauge.set(len(self._sessions))
+        summary = {"session": sess.sid, "frames": sess.frames,
+                   "pairs": sess.pairs, "warm_pairs": sess.warm_pairs}
+        self._sink.emit("stream_close", sid=sess.sid,
+                        frames=sess.frames, pairs=sess.pairs,
+                        warm_pairs=sess.warm_pairs)
+        return summary
+
+    def _sweep_unpinned_locked(self, now: float) -> list:
+        """Evict expired sessions that never pinned a lane (opened,
+        then abandoned) — pinned ones are swept by their bucket's
+        dispatcher, which owns the lane.  Caller holds
+        ``_sessions_lock``; events are emitted by the caller OUTSIDE
+        the lock (:meth:`_emit_evictions`)."""
+        out = []
+        for sid, s in list(self._sessions.items()):
+            if s.lane is not None:
+                continue
+            if s.inflight is not None and not s.inflight.done():
+                continue
+            if now - s.t_last > s.ttl_s:
+                del self._sessions[sid]
+                s.closed = True
+                out.append(s)
+        if out:
+            self._sessions_gauge.set(len(self._sessions))
+        return out
+
+    def _emit_evictions(self, evicted: list) -> None:
+        for s in evicted:
+            self._stream_evicted.inc()
+            self._sink.emit(
+                "stream_evict", sid=s.sid,
+                bucket=f"{s.bucket[0]}x{s.bucket[1]}",
+                lane=-1 if s.lane is None else int(s.lane),
+                idle_s=round(time.time() - s.t_last, 3),
+                ttl_s=s.ttl_s)
+
     def warmup(self, image_shapes: Sequence[Tuple[int, int]],
                batch_sizes: Optional[Sequence[int]] = None) -> List[tuple]:
         """Pre-compile the ``(bucket, lanes)`` program pairs for the
@@ -768,6 +1123,22 @@ class InferenceEngine:
         out["latency_ms"] = self._latency.snapshot()
         out["batching"] = self.cfg.batching
         out["iters_used"] = self._iters_used.snapshot()
+        out["iters_used_warm"] = self._iters_used_warm.snapshot()
+        out["iters_used_cold"] = self._iters_used_cold.snapshot()
+        # Streaming-session snapshot; sweeping lane-less expired
+        # sessions here keeps the gauge honest even when no frames
+        # arrive to trigger the open-path sweep.
+        with self._sessions_lock:
+            expired = self._sweep_unpinned_locked(time.time())
+        self._emit_evictions(expired)
+        with self._sessions_lock:
+            out["sessions"] = {
+                "open": len(self._sessions),
+                "pinned": sum(1 for s in self._sessions.values()
+                              if s.lane is not None),
+                "frames_total": int(self._stream_frames.value()),
+                "evicted_total": int(self._stream_evicted.value()),
+            }
         out["compiles"] = {
             f"{hw[0]}x{hw[1]}/b{bs}/{prog}": n
             for (hw, bs, prog), n in sorted(
@@ -871,7 +1242,19 @@ class InferenceEngine:
         try:
             while True:
                 if not waiting and not pool.live():
-                    waiting.append(await q.get())
+                    if pool.pins:
+                        # Idle but holding pinned session lanes: wake
+                        # at the earliest possible TTL expiry so the
+                        # sweep in _slot_cycle can evict and return
+                        # lanes to the free pool.
+                        try:
+                            waiting.append(await asyncio.wait_for(
+                                q.get(),
+                                timeout=self._pin_poll_s(pool)))
+                        except asyncio.TimeoutError:
+                            pass
+                    else:
+                        waiting.append(await q.get())
                 while True:
                     try:
                         waiting.append(q.get_nowait())
@@ -893,6 +1276,14 @@ class InferenceEngine:
                 with self._pending_lock:
                     self._pending -= len(leftovers)
             raise
+
+    def _pin_poll_s(self, pool: _SlotPool) -> float:
+        """Idle-poll interval while lanes are pinned: sleep until the
+        earliest session TTL could expire, clamped to [0.05, 1.0] s."""
+        now = time.time()
+        nxt = min((s.t_last + s.ttl_s for s in pool.pins.values()),
+                  default=now + 1.0)
+        return float(min(max(nxt - now, 0.05), 1.0))
 
     # ------------------------------------------------------------------
     # internals — device-worker thread
@@ -944,6 +1335,58 @@ class InferenceEngine:
             progs = _Programs(enc, it, template, bucket, lanes,
                               self.cfg.iters)
             self._programs[pkey] = progs
+            return progs
+
+    def _get_stream_programs(self, bucket: tuple,
+                             lanes: int) -> _Programs:
+        """The streaming extras for ``(bucket, lanes)`` — the zero
+        carry plus compiled ``stash`` (frame-2 feature snapshot) and
+        ``wenc`` (warm encode) programs — filled into the bucket's
+        ``_Programs`` on the first streamed pair, so non-streaming
+        traffic never compiles them.  Same compile-once,
+        AOT-importable, cost-stamped discipline as :meth:`_get_programs`
+        (``wenc``'s smaller ``flops_per_pair`` vs ``enc`` IS the
+        per-frame encoder saving, visible in ``stats()["cost"]``)."""
+        progs = self._get_programs(bucket, lanes)
+        if progs.wenc is not None:
+            return progs
+        with self._compile_lock:
+            if progs.wenc is not None:
+                return progs
+            H, W = bucket
+            carry_tpl = self._slots_mod.carry_template(
+                self._model_cfg, self._variables, lanes, bucket)
+            carry_spec = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                carry_tpl)
+            state_spec = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                progs.template)
+            im = jax.ShapeDtypeStruct((lanes, H, W, 3), jnp.float32)
+            mask = jax.ShapeDtypeStruct((lanes,), jnp.bool_)
+            budg = jax.ShapeDtypeStruct((lanes,), jnp.int32)
+            stash = self._executables.get((bucket, lanes, "stash"))
+            if stash is None:
+                stash = self._stash_jit.lower(
+                    self._variables, im, carry_spec, mask).compile()
+                self._executables[(bucket, lanes, "stash")] = stash
+                self.compile_counter.record((bucket, lanes, "stash"))
+            wenc = self._executables.get((bucket, lanes, "wenc"))
+            if wenc is None:
+                wenc = self._warm_jit.lower(
+                    self._variables, im, carry_spec, state_spec,
+                    mask, budg).compile()
+                self._executables[(bucket, lanes, "wenc")] = wenc
+                self.compile_counter.record((bucket, lanes, "wenc"))
+            for prog, exe in (("stash", stash), ("wenc", wenc)):
+                key = (bucket, lanes, prog)
+                if self.cost_book.get(key) is None:
+                    self.cost_book.stamp(key, cost_mod.program_cost(
+                        exe, program=f"serve_{prog}_{H}x{W}_b{lanes}",
+                        pairs_per_call=lanes))
+            progs.carry0 = jax.device_put(carry_tpl)
+            progs.stash = stash
+            progs.wenc = wenc
             return progs
 
     def _pipeline_cost_attrs(self, bucket: tuple, lanes: int,
@@ -1193,13 +1636,9 @@ class InferenceEngine:
             if pool.progs is None:
                 pool.progs = self._get_programs(bucket, self.cfg.slots)
                 pool.state = pool.progs.state0
+            self._sweep_pins(bucket, pool)
             if waiting:
-                free = [i for i in range(self.cfg.slots)
-                        if pool.reqs[i] is None]
-                if free:
-                    admits = [(i, waiting.pop(0))
-                              for i in free[:len(waiting)]]
-                    self._admit_slots(bucket, pool, admits, seq)
+                self._admit_cycle(bucket, pool, waiting, seq)
             if pool.active_np.any():
                 self._iter_slots(bucket, pool, seq)
         except Exception as e:
@@ -1224,8 +1663,96 @@ class InferenceEngine:
             with self._pending_lock:
                 self._last_batch_done = time.perf_counter()
 
+    def _sweep_pins(self, bucket: tuple, pool: _SlotPool) -> None:
+        """Evict closed/expired pinned sessions and return their lanes
+        to the free pool.  Runs on the device worker at the top of
+        every cycle (the dispatcher's idle poll guarantees cycles keep
+        happening while pins exist); no device work — the lane's carry
+        simply stops being referenced and the next admit overwrites
+        it."""
+        now = time.time()
+        evicted = []
+        for lane, s in list(pool.pins.items()):
+            if pool.reqs[lane] is not None or (
+                    s.inflight is not None and not s.inflight.done()):
+                continue
+            if s.closed:
+                del pool.pins[lane]
+                s.lane = None
+            elif now - s.t_last > s.ttl_s:
+                del pool.pins[lane]
+                s.lane = None
+                s.closed = True
+                evicted.append((lane, s))
+        if not evicted:
+            return
+        with self._sessions_lock:
+            for _, s in evicted:
+                self._sessions.pop(s.sid, None)
+            self._sessions_gauge.set(len(self._sessions))
+        for lane, s in evicted:
+            self._stream_evicted.inc()
+            self._sink.emit("stream_evict", sid=s.sid,
+                            bucket=f"{bucket[0]}x{bucket[1]}",
+                            lane=lane,
+                            idle_s=round(now - s.t_last, 3),
+                            ttl_s=s.ttl_s)
+
+    def _admit_cycle(self, bucket: tuple, pool: _SlotPool,
+                     waiting: List[_Request], seq: int) -> None:
+        """Partition the waiting FIFO into this cycle's admissions.
+        Stateless requests fill free UNPINNED lanes (oldest first,
+        lowest lane first).  A session frame goes to its pinned lane —
+        pinning one on its first pair — via the warm program when the
+        lane's carry is valid, else the cold path plus a carry stash.
+        Requests that found no lane stay in ``waiting`` in order."""
+        S = self.cfg.slots
+        free = [i for i in range(S)
+                if pool.reqs[i] is None and i not in pool.pins]
+        cold: List[tuple] = []    # (lane, request): full-pair encode
+        warm: List[tuple] = []    # (lane, request): warm encode
+        stash: List[tuple] = []   # cold subset that seeds a carry
+        leftover: List[_Request] = []
+        for r in waiting:
+            sess = r.session
+            if sess is None:
+                if free:
+                    cold.append((free.pop(0), r))
+                else:
+                    leftover.append(r)
+                continue
+            lane = sess.lane
+            if lane is None or pool.pins.get(lane) is not sess:
+                if not free:
+                    leftover.append(r)
+                    continue
+                lane = free.pop(0)
+                sess.lane = lane
+                pool.pins[lane] = sess
+            if pool.reqs[lane] is not None:
+                # One frame in flight per session makes this
+                # unreachable in practice; requeue defensively.
+                leftover.append(r)
+                continue
+            if r.warm and sess.carry_ok:
+                warm.append((lane, r))
+            else:
+                # Cold (first pair, or carry invalidated by a reset /
+                # stash failure): the unmodified encode program — bit
+                # parity with the stateless path — plus a carry stash
+                # so the NEXT frame can run warm.
+                r.warm = False
+                cold.append((lane, r))
+                stash.append((lane, r))
+        waiting[:] = leftover
+        if cold:
+            if self._admit_slots(bucket, pool, cold, seq) and stash:
+                self._stash_carry(bucket, pool, stash, seq)
+        if warm:
+            self._admit_warm(bucket, pool, warm, seq)
+
     def _admit_slots(self, bucket: tuple, pool: _SlotPool,
-                     admits: List[tuple], seq: int) -> None:
+                     admits: List[tuple], seq: int) -> bool:
         """Encode ``admits`` (``(slot_index, request)`` pairs) into
         their lanes.  The encode program scatters fresh state into the
         admitted lanes only — the other lanes' device state is carried
@@ -1264,11 +1791,11 @@ class InferenceEngine:
             self._counters.add_failed_lanes(len(admits))
             self._sink.emit("serve_admit_error",
                             bucket=f"{bucket[0]}x{bucket[1]}",
-                            admits=len(admits),
+                            admits=len(admits), warm=False,
                             error=f"{type(e).__name__}: {e}")
             with self._pending_lock:
                 self._pending -= len(admits)
-            return
+            return False
         pool.state = state
         pool.active_np = active
         pool.budgets = budgets
@@ -1282,7 +1809,114 @@ class InferenceEngine:
                 trace.record_span(r.trace, "pad", t0, t_pad, slot=i)
         self._sink.emit("serve_admit",
                         bucket=f"{bucket[0]}x{bucket[1]}",
-                        admits=len(admits), seq=seq,
+                        admits=len(admits), seq=seq, warm=False,
+                        seconds=round(t_done - t0, 6))
+        return True
+
+    def _stash_carry(self, bucket: tuple, pool: _SlotPool,
+                     admits: List[tuple], seq: int) -> None:
+        """Snapshot frame 2's features into freshly cold-admitted
+        session lanes' carry.  One extra encoder pass per session
+        open — deliberately a SEPARATE program so the cold pair itself
+        runs the unmodified ``enc`` executable (bit parity with the
+        stateless path).  Failure never fails the pair: the session
+        just stays cold and re-seeds on its next frame."""
+        S = self.cfg.slots
+        H, W = bucket
+        t0 = time.perf_counter()
+        progs = self._get_stream_programs(bucket, S)
+        if pool.carry is None:
+            pool.carry = progs.carry0
+        a2 = np.zeros((S, H, W, 3), np.float32)
+        admit = np.zeros((S,), bool)
+        for i, r in admits:
+            a2[i] = r.padder.pad_np(r.image2)
+            admit[i] = True
+
+        def thunk():
+            carry = progs.stash(self._variables, a2, pool.carry, admit)
+            # Blocks on a small slice — async dispatch errors surface
+            # here, inside the retry scope, before the carry commits.
+            np.asarray(carry["fmap"][0, :1, 0, 0])
+            return carry
+
+        try:
+            carry = self._retry_call(bucket, seq, thunk)
+        except Exception as e:
+            for _, r in admits:
+                if r.session is not None:
+                    r.session.carry_ok = False
+            self._sink.emit("stream_stash_error",
+                            bucket=f"{H}x{W}", lanes=len(admits),
+                            error=f"{type(e).__name__}: {e}")
+            return
+        pool.carry = carry
+        for _, r in admits:
+            if r.session is not None:
+                r.session.carry_ok = True
+
+    def _admit_warm(self, bucket: tuple, pool: _SlotPool,
+                    admits: List[tuple], seq: int) -> None:
+        """Warm-admit session frames into their pinned lanes: only the
+        new image runs through the encoders (the carried fmap/ctx
+        stand in for frame 1) and ``coords1`` starts from the lane's
+        previous flow forward-warped by itself.  Scatter semantics
+        match :meth:`_admit_slots` — a failed warm admit fails just
+        the admitted frames (marking their sessions cold) and leaves
+        every live lane serving."""
+        S = self.cfg.slots
+        H, W = bucket
+        t0 = time.perf_counter()
+        progs = self._get_stream_programs(bucket, S)
+        if pool.carry is None:
+            pool.carry = progs.carry0
+        a2 = np.zeros((S, H, W, 3), np.float32)
+        admit = np.zeros((S,), bool)
+        budgets = pool.budgets.copy()
+        for i, r in admits:
+            a2[i] = r.padder.pad_np(r.image2)
+            admit[i] = True
+            budgets[i] = min(int(r.iters or self.cfg.iters),
+                             self.cfg.iters)
+        t_pad = time.perf_counter()
+
+        def thunk():
+            state, carry = progs.wenc(self._variables, a2, pool.carry,
+                                      pool.state, admit, budgets)
+            active = np.asarray(state["active"])
+            return state, carry, active
+
+        try:
+            state, carry, active = self._retry_call(bucket, seq, thunk)
+        except Exception as e:
+            for _, r in admits:
+                if not r.future.done():
+                    r.future.set_exception(e)
+                if r.session is not None:
+                    r.session.carry_ok = False
+            self._counters.add_failed_lanes(len(admits))
+            self._sink.emit("serve_admit_error",
+                            bucket=f"{H}x{W}",
+                            admits=len(admits), warm=True,
+                            error=f"{type(e).__name__}: {e}")
+            with self._pending_lock:
+                self._pending -= len(admits)
+            return
+        pool.state = state
+        pool.carry = carry
+        pool.active_np = active
+        pool.budgets = budgets
+        t_done = time.perf_counter()
+        for i, r in admits:
+            pool.reqs[i] = r
+            pool.t_admit[i] = t_done
+            if r.trace is not None:
+                trace.record_span(r.trace, "queue", r.t_submit, t0,
+                                  batch=seq, slot=i)
+                trace.record_span(r.trace, "pad", t0, t_pad, slot=i)
+        self._sink.emit("serve_admit",
+                        bucket=f"{H}x{W}",
+                        admits=len(admits), seq=seq, warm=True,
                         seconds=round(t_done - t0, 6))
 
     def _iter_slots(self, bucket: tuple, pool: _SlotPool,
@@ -1366,9 +2000,15 @@ class InferenceEngine:
             used = int(iters_done[i])
             self._latency.record(t_done - r.t_submit)
             self._iters_used.record(used)
+            (self._iters_used_warm if r.warm
+             else self._iters_used_cold).record(used)
             self._counters.add_completed()
+            if r.session is not None:
+                r.session.pairs += 1
+                if r.warm:
+                    r.session.warm_pairs += 1
             self._sink.emit("serve_retire", bucket=bk, slot=i,
-                            iters=used,
+                            iters=used, warm=bool(r.warm),
                             converged=bool(converged_np[i]),
                             seconds=round(t_done - r.t_submit, 6))
             qattrs = None
@@ -1393,6 +2033,7 @@ class InferenceEngine:
             if r.trace is not None:
                 trace.record_span(r.trace, "device", pool.t_admit[i],
                                   t_done, bucket=bk, iters=used,
+                                  warm=bool(r.warm),
                                   retries=retries, **(qattrs or {}))
                 if retries:  # tail-keep: a retried request is news
                     r.trace.mark_keep()

@@ -47,6 +47,19 @@ Failure handling:
   full), ``submit()`` raises :class:`QueueFullError` carrying the
   fleet-wide queue depth — the HTTP edge turns it into a structured
   429 with Retry-After.
+
+Streaming sessions (docs/SERVING.md "Streaming sessions"): a session's
+device state (pinned lane, warm carry) lives in ONE replica's engine,
+so the router layers **session affinity** on top of bucket affinity —
+the replica picked at open serves every frame.  That state dies with
+the engine; the router's job is to make that loss invisible at the
+cost of one cold frame.  It keeps each stream's last frame host-side
+and, when the pinned replica was restarted (rolling
+``update_weights``, detected by the replica's ``generation`` bump) or
+fails over mid-frame, re-opens the session on an eligible replica by
+replaying that frame as the new frame 0 — the next pair runs cold and
+the stream continues, monotone frame numbering intact
+(``stream_restart`` event, ``raft_fleet_stream_restarts_total``).
 """
 
 from __future__ import annotations
@@ -165,6 +178,33 @@ class _RoutedRequest:
             self.timer = None
 
 
+class _RoutedStream:
+    """Router-side record of one streaming session: which replica owns
+    its device state, the generation/weights stamps that detect a
+    restart, and the last frame (host-side) that seeds a cold re-open.
+    ``lock`` serializes the session's frames through the router —
+    streams are sequential by contract, so this costs nothing."""
+
+    __slots__ = ("sid", "bucket", "iters", "ttl_s", "replica",
+                 "generation", "weights_version", "last_image",
+                 "frames", "restarts", "t_last", "lock")
+
+    def __init__(self, sid, bucket, iters, ttl_s, replica,
+                 generation, weights_version, image):
+        self.sid = sid
+        self.bucket = bucket
+        self.iters = iters
+        self.ttl_s = ttl_s
+        self.replica = replica          # name, not the object
+        self.generation = generation
+        self.weights_version = weights_version
+        self.last_image = image
+        self.frames = 0                 # router-monotone frame index
+        self.restarts = 0
+        self.t_last = time.time()
+        self.lock = threading.Lock()
+
+
 class FlowRouter:
     """See module docstring.  ``fleet`` is duck-typed: it exposes
     ``replicas`` (list of :class:`raft_tpu.serve.fleet.Replica`),
@@ -199,6 +239,12 @@ class FlowRouter:
         self._latency = LatencyRecorder(
             registry=self.registry,
             metric="raft_fleet_request_latency_seconds")
+        self._streams: dict = {}
+        self._streams_lock = threading.Lock()
+        self._stream_restarts = self.registry.counter(
+            "raft_fleet_stream_restarts_total",
+            "streaming sessions cold-restarted on a new engine "
+            "(weight update or failover)")
 
     # ------------------------------------------------------------------
     # client API (any thread)
@@ -236,6 +282,144 @@ class FlowRouter:
     def infer(self, image1, image2,
               timeout: Optional[float] = None) -> np.ndarray:
         return self.submit(image1, image2).result(timeout=timeout)
+
+    # ------------------------------------------------------------------
+    # streaming sessions (session affinity + cold-restart fallback)
+    # ------------------------------------------------------------------
+
+    def _replica_by_name(self, name: str):
+        for r in self.fleet.replicas:
+            if r.name == name:
+                return r
+        return None
+
+    def stream_ingest(self, session_id: str, image, *,
+                      iters: Optional[int] = None,
+                      ttl_s: Optional[float] = None,
+                      timeout: Optional[float] = None) -> dict:
+        """Fleet-side ``POST /v1/stream/{id}`` semantics (same facade
+        as ``InferenceEngine.stream_ingest``): first frame opens the
+        session on the bucket-affine replica; later frames go to THAT
+        replica.  A restarted or failed owner triggers one cold
+        re-open (module docstring); ``flow`` comes back ``None`` on
+        any frame that (re-)seeded rather than produced a pair."""
+        im = np.asarray(image, dtype=np.float32)
+        if im.ndim != 3 or im.shape[-1] != 3:
+            raise ValueError(
+                f"expected an (H, W, 3) image, got {im.shape}")
+        sid = str(session_id)
+        scfg = self.fleet.serve_cfg
+        with self._streams_lock:
+            self._sweep_streams_locked(time.time())
+            rst = self._streams.get(sid)
+            if rst is None:
+                bucket = bucket_hw(im.shape[0], im.shape[1],
+                                   scfg.bucket_multiple, scfg.buckets)
+                replica = self._pick(bucket, set())
+                if replica is None:
+                    states = {r.name: r.state
+                              for r in self.fleet.replicas}
+                    raise RuntimeError(
+                        f"no eligible replica to open stream "
+                        f"{sid!r} on (fleet states: {states})")
+                replica.engine.stream_open(sid, im, iters=iters,
+                                           ttl_s=ttl_s)
+                rst = _RoutedStream(
+                    sid, bucket, iters,
+                    float(ttl_s) if ttl_s is not None
+                    else scfg.stream_ttl_s,
+                    replica.name, replica.generation,
+                    getattr(self.fleet, "weights_version", 0), im)
+                self._streams[sid] = rst
+                self._requests.inc(replica=replica.name)
+                return {"session": sid, "frame": 0, "warm": False,
+                        "flow": None}
+        with rst.lock:
+            replica = self._replica_by_name(rst.replica)
+            wv = getattr(self.fleet, "weights_version", 0)
+            if (replica is None or not replica.eligible()
+                    or replica.generation != rst.generation):
+                reason = ("weights_update"
+                          if wv != rst.weights_version
+                          else "replica_lost")
+                replica = self._restart_stream(rst, reason, set())
+            try:
+                out = replica.engine.stream_ingest(sid, im,
+                                                   timeout=timeout)
+            except QueueFullError:
+                raise  # backoff, session intact — client retries
+            except Exception as e:
+                if not is_failover_error(e):
+                    raise
+                if replica.generation == rst.generation:
+                    replica.note_failure(self.cfg.breaker_threshold,
+                                         self.cfg.breaker_cooldown_s)
+                replica = self._restart_stream(rst, "failover",
+                                               {replica.name})
+                out = replica.engine.stream_ingest(sid, im,
+                                                   timeout=timeout)
+            self._requests.inc(replica=replica.name)
+            rst.last_image = im
+            rst.frames += 1
+            rst.t_last = time.time()
+            return {"session": sid, "frame": rst.frames,
+                    "warm": bool(out["warm"]), "flow": out["flow"]}
+
+    def stream_close(self, session_id: str) -> dict:
+        """Close a stream everywhere; returns the owning engine's
+        summary when it is still reachable, a router-side one when the
+        device state is already gone (restart raced the close)."""
+        sid = str(session_id)
+        with self._streams_lock:
+            rst = self._streams.pop(sid, None)
+        if rst is None:
+            raise ValueError(f"unknown session {session_id!r} "
+                             "(expired, closed, or never opened)")
+        with rst.lock:
+            summary = None
+            replica = self._replica_by_name(rst.replica)
+            if (replica is not None
+                    and replica.generation == rst.generation):
+                try:
+                    summary = replica.engine.stream_close(sid)
+                except Exception:
+                    summary = None
+            if summary is None:
+                summary = {"session": sid, "frames": rst.frames + 1,
+                           "pairs": rst.frames, "warm_pairs": None}
+            summary["restarts"] = rst.restarts
+            return summary
+
+    def _restart_stream(self, rst: _RoutedStream, reason: str,
+                        exclude: Set[str]):
+        """Re-open ``rst`` cold on an eligible replica, replaying its
+        last frame as the new frame 0.  Called with ``rst.lock`` held.
+        The old engine's copy (if any survives) is left to its TTL
+        eviction — closing it could block on a dead replica."""
+        target = self._pick(rst.bucket, exclude)
+        if target is None:
+            states = {r.name: r.state for r in self.fleet.replicas}
+            raise RuntimeError(
+                f"stream {rst.sid!r}: no eligible replica to restart "
+                f"on (fleet states: {states})")
+        target.engine.stream_open(rst.sid, rst.last_image,
+                                  iters=rst.iters, ttl_s=rst.ttl_s)
+        prev = rst.replica
+        rst.replica = target.name
+        rst.generation = target.generation
+        rst.weights_version = getattr(self.fleet, "weights_version", 0)
+        rst.restarts += 1
+        self._stream_restarts.inc()
+        self._sink.emit("stream_restart", sid=rst.sid,
+                        bucket=f"{rst.bucket[0]}x{rst.bucket[1]}",
+                        from_replica=prev, to_replica=target.name,
+                        reason=reason)
+        return target
+
+    def _sweep_streams_locked(self, now: float) -> None:
+        for sid, rst in list(self._streams.items()):
+            if now - rst.t_last > rst.ttl_s and not rst.lock.locked():
+                del self._streams[sid]
 
     # ------------------------------------------------------------------
     # placement
@@ -466,6 +650,9 @@ class FlowRouter:
             "rejected_total": self._rejected.value(),
             "dropped_total": self._dropped.value(),
             "latency_ms": self._latency.snapshot(),
+            "streams_open": len(self._streams),
+            "stream_restarts_total": int(
+                self._stream_restarts.value()),
         }
 
     def stats(self) -> dict:

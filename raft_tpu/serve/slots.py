@@ -51,6 +51,22 @@ programs can differ in the last ulp (see the note in
 sweeping it (``evaluate.py --early_exit_threshold``, autotune) never
 recompiles.  ``threshold <= 0`` disables early exit: ``delta_max`` is a
 max of norms, hence ``>= 0``, and the predicate is a strict ``<``.
+
+Streaming sessions (docs/SERVING.md "Streaming sessions") add two more
+programs over the SAME slot state — the state pytree above is untouched,
+so ``iter_step`` and the AOT artifacts stay byte-compatible:
+
+- ``stash_carry(variables, image2, carry, admit)``: after a session's
+  first (cold) pair is admitted through the unmodified ``encode_admit``
+  (single-frame bit parity is structural), stash frame 2's feature map
+  and raw context-encoder output into the lane's *carry* — a separate
+  ``{"ctx", "fmap"}`` pytree the iter program never sees.
+- ``encode_warm(variables, image2, carry, state, admit, budgets)``:
+  admit streamed frame N+1 with only the NEW image — ``fmap1`` comes
+  from the carry (consecutive-frame identity), the lane's previous flow
+  (``coords1 - coords0``, still device-resident from its retirement) is
+  forward-warped in-graph into the ``coords1`` init, and the new
+  frame's features are returned as the next carry.
 """
 
 from __future__ import annotations
@@ -62,7 +78,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.config import RAFTConfig
-from raft_tpu.models.raft import RAFTEncode, RAFTIterStep, RAFTUpsample
+from raft_tpu.models.raft import (RAFTEncode, RAFTEncodeWarm,
+                                  RAFTFrameFeatures, RAFTIterStep,
+                                  RAFTUpsample)
+from raft_tpu.ops.sampler import forward_warp_flow
 
 
 def _lane_select(mask, new, old):
@@ -135,6 +154,81 @@ def make_encode_fn(model_cfg: RAFTConfig):
         )
 
     return encode_admit
+
+
+def carry_template(model_cfg: RAFTConfig, variables, slots: int,
+                   bucket_hw: Tuple[int, int]) -> dict:
+    """Host-side all-zeros streaming carry for ``slots`` lanes: the
+    previous frame's feature map (``fmap``, fp32) and raw context
+    output (``ctx``, model dtype), shaped via ``jax.eval_shape`` of the
+    stash program so dtype/shape can never drift from what
+    ``stash_carry`` produces.  Kept OUTSIDE the slot state on purpose:
+    ``iter_step`` never reads it, so non-streaming engines pay nothing
+    and existing AOT artifacts stay valid."""
+    H, W = bucket_hw
+    spec = jax.ShapeDtypeStruct((slots, H, W, 3), jnp.float32)
+    fmap, ctx = jax.eval_shape(
+        RAFTFrameFeatures(model_cfg).apply, variables, spec)
+    zeros = lambda s: np.zeros(s.shape, dtype=s.dtype)
+    return {"ctx": zeros(ctx), "fmap": zeros(fmap)}
+
+
+def make_stash_fn(model_cfg: RAFTConfig):
+    """``stash_carry(variables, image2, carry, admit) -> carry'``
+    (pure; the engine jits/lowers it).
+
+    Runs after a cold session admit: computes frame 2's features and
+    scatters them into the admitted lanes' carry.  One extra encoder
+    pass per session (frame 1 only) buys fmap reuse for every
+    subsequent warm frame."""
+    feats = RAFTFrameFeatures(model_cfg)
+
+    def stash_carry(variables, image2, carry, admit):
+        fmap, ctx = feats.apply(variables, image2)
+        sel = lambda new, old: _lane_select(admit, new, old)
+        return {"ctx": sel(ctx, carry["ctx"]),
+                "fmap": sel(fmap, carry["fmap"])}
+
+    return stash_carry
+
+
+def make_warm_encode_fn(model_cfg: RAFTConfig):
+    """``encode_warm(variables, image2, carry, state, admit, budgets)
+    -> (state', carry')`` (pure; the engine jits/lowers it).
+
+    The streaming admit: for lanes in ``admit``, frame 1 of the pair is
+    the PREVIOUS streamed frame — its feature map and context come from
+    the carry, so only ``image2`` (the new frame) runs through the
+    encoders.  The lane's previous flow (``coords1 - coords0``, intact
+    since its retirement: ``iter_step``'s masked commit never touches
+    inactive lanes) is forward-warped on-device into the ``coords1``
+    init — RAFT's video warm start.  The scatter semantics mirror
+    :func:`make_encode_fn` exactly; ``carry'`` holds the new frame's
+    features for the next warm frame."""
+    enc = RAFTEncodeWarm(model_cfg)
+
+    def encode_warm(variables, image2, carry, state, admit, budgets):
+        flow_init = forward_warp_flow(state["coords1"] - state["coords0"])
+        net, inp, coords0, coords1, corr, fmap2, ctx2 = enc.apply(
+            variables, image2, carry["fmap"], carry["ctx"], flow_init)
+        sel = lambda new, old: _lane_select(admit, new, old)
+        new_state = _pack_state(
+            sel(net, state["net"]),
+            sel(inp, state["inp"]),
+            sel(coords0, state["coords0"]),
+            sel(coords1, state["coords1"]),
+            jax.tree_util.tree_map(sel, corr, state["corr"]),
+            state["active"] | admit,
+            jnp.where(admit, budgets.astype(jnp.int32), state["budget"]),
+            state["converged"] & ~admit,
+            jnp.where(admit, jnp.float32(-1.0), state["delta_max"]),
+            jnp.where(admit, jnp.int32(0), state["iters_done"]),
+        )
+        new_carry = {"ctx": sel(ctx2, carry["ctx"]),
+                     "fmap": sel(fmap2, carry["fmap"])}
+        return new_state, new_carry
+
+    return encode_warm
 
 
 def make_iter_fn(model_cfg: RAFTConfig):

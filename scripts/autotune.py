@@ -173,12 +173,15 @@ def _grid(kind: str, tiny: bool, allow_quantized: bool):
             "fused_gru": fused_axis,
         }
     if tiny:
-        # Keep the tiny sweep at 4 points: both fused knobs ride the
-        # sweep -> save_entry -> resolve_config loop (they resolve to
-        # the unfused paths on the CPU smoke backend — this is plumbing
-        # coverage, not a kernel measurement).
+        # Keep the tiny sweep at 2 points: one fused knob rides the
+        # sweep -> save_entry -> resolve_config loop (it resolves to
+        # the unfused path on the CPU smoke backend — this is plumbing
+        # coverage, not a kernel measurement).  fused_lookup_encoder is
+        # a single-value axis: the second fused knob doubled the smoke's
+        # compile count without adding coverage the fused_gru axis
+        # doesn't already give.
         return {"scan_unroll": [1],
-                "fused_lookup_encoder": [False, True],
+                "fused_lookup_encoder": [False],
                 "fused_gru": [False, True]}
     if kind == "eval":
         grid = {

@@ -113,6 +113,24 @@ def parse_args(argv=None):
                         "drift gate must not pass because quality "
                         "scoring silently turned off (unset = no "
                         "check)")
+    p.add_argument("--min-warm-iters-saved-frac", type=float,
+                   default=None, metavar="FRAC",
+                   help="fail when a newest record's "
+                        "config.warm_iters_saved_frac (1 - warm-frame "
+                        "iters_used p50 / cold p50, from "
+                        "scripts/bench_stream.py; docs/SERVING.md "
+                        "'Streaming sessions') is below this floor — "
+                        "the warm start stopped saving refinement "
+                        "work; also fails when NO record carries the "
+                        "figure (unset = no check)")
+    p.add_argument("--max-stream-epe-delta", type=float, default=None,
+                   metavar="EPE",
+                   help="fail when a newest record's "
+                        "config.stream_epe_delta (streamed-arm EPE "
+                        "minus independent-pair EPE on identical "
+                        "frames, from scripts/bench_stream.py) exceeds "
+                        "this; also fails when NO record carries the "
+                        "figure (unset = no check)")
     p.add_argument("--max-canary-proxy-delta", type=float, default=None,
                    metavar="PCT",
                    help="fail when a newest record's "
@@ -251,7 +269,8 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
           max_serve_error_rate=0.0, max_critical_path_ms=None,
           max_early_exit_epe_delta=None, max_kernel_slowdown=None,
           min_mfu=None, max_flops_per_pair_growth=None,
-          max_quality_drift=None, max_canary_proxy_delta=None):
+          max_quality_drift=None, max_canary_proxy_delta=None,
+          min_warm_iters_saved_frac=None, max_stream_epe_delta=None):
     """``(failures, report)`` over the newest record of each metric."""
     failures, report = [], []
     cp_gates = dict(max_critical_path_ms or {})
@@ -264,6 +283,8 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
     fpp_seen = False
     qd_seen = False
     cpx_seen = False
+    wis_seen = False
+    sed_seen = False
     for metric, recs in sorted(series.items()):
         newest = recs[-1]
         value = newest.get("value")
@@ -428,6 +449,32 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
                         f"budget {max_canary_proxy_delta:g}% — the "
                         "weight-update canary scored worse on the "
                         "golden batch than the live fleet")
+        # Streaming warm-start gates (scripts/bench_stream.py,
+        # docs/SERVING.md "Streaming sessions"): warm-started frames
+        # must keep converging in fewer iterations than cold ones, and
+        # the accuracy cost of carrying state across frames stays
+        # inside its budget.
+        if min_warm_iters_saved_frac is not None:
+            wis = cfg.get("warm_iters_saved_frac")
+            if isinstance(wis, (int, float)):
+                wis_seen = True
+                if wis < min_warm_iters_saved_frac:
+                    failures.append(
+                        f"{metric}: warm_iters_saved_frac {wis:g} < "
+                        f"floor {min_warm_iters_saved_frac:g} — "
+                        "warm-started frames no longer converge "
+                        "meaningfully faster than cold ones (broken "
+                        "carry-over or a mis-set warm budget)")
+        if max_stream_epe_delta is not None:
+            sed = cfg.get("stream_epe_delta")
+            if isinstance(sed, (int, float)):
+                sed_seen = True
+                if sed > max_stream_epe_delta:
+                    failures.append(
+                        f"{metric}: stream_epe_delta {sed:g} > budget "
+                        f"{max_stream_epe_delta:g} — streaming warm "
+                        "start costs more accuracy vs independent "
+                        "pairs than the budget allows")
         sn = cfg.get("serve_span_names")
         if isinstance(sn, list) and sn:
             missing = sorted(set(SERVE_REQUIRED_SPANS) - set(sn))
@@ -495,6 +542,18 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
             "(ServeConfig.quality_sample_rate 0, or the summary "
             "predates the quality proxies); the gate cannot pass "
             "vacuously")
+    if min_warm_iters_saved_frac is not None and not wis_seen:
+        failures.append(
+            "warm-iters gate: no record carries "
+            "config.warm_iters_saved_frac — the streaming bench "
+            "(scripts/bench_stream.py) did not run, or its warm/cold "
+            "histograms were empty; the gate cannot pass vacuously")
+    if max_stream_epe_delta is not None and not sed_seen:
+        failures.append(
+            "stream-epe gate: no record carries "
+            "config.stream_epe_delta — the streaming bench "
+            "(scripts/bench_stream.py) did not run both arms; the "
+            "gate cannot pass vacuously")
     if max_canary_proxy_delta is not None and not cpx_seen:
         failures.append(
             "canary-proxy gate: no record carries "
@@ -735,6 +794,32 @@ def _selftest() -> int:
         ("canary proxy delta without the gate passes",
          run([30.0, 31.0, 30.5],
              last_cfg={"canary_proxy_delta_pct": 999.0}), False),
+        ("warm iters saving above floor passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"warm_iters_saved_frac": 0.4},
+             min_warm_iters_saved_frac=0.1), False),
+        ("warm iters saving below floor fails",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"warm_iters_saved_frac": 0.02},
+             min_warm_iters_saved_frac=0.1), True),
+        ("warm-iters gate without data fails",
+         run([30.0, 31.0, 30.5], min_warm_iters_saved_frac=0.1), True),
+        ("zero warm saving without the gate passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"warm_iters_saved_frac": 0.0}), False),
+        ("stream EPE delta within budget passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"stream_epe_delta": 0.02},
+             max_stream_epe_delta=0.1), False),
+        ("stream EPE delta over budget fails",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"stream_epe_delta": 0.7},
+             max_stream_epe_delta=0.1), True),
+        ("stream-epe gate without data fails",
+         run([30.0, 31.0, 30.5], max_stream_epe_delta=0.1), True),
+        ("high stream EPE delta without the gate passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"stream_epe_delta": 9.0}), False),
     ]
 
     def run_lint(payload):
@@ -808,7 +893,11 @@ def main(argv=None):
                                  args.max_flops_per_pair_growth),
                              max_quality_drift=args.max_quality_drift,
                              max_canary_proxy_delta=(
-                                 args.max_canary_proxy_delta))
+                                 args.max_canary_proxy_delta),
+                             min_warm_iters_saved_frac=(
+                                 args.min_warm_iters_saved_frac),
+                             max_stream_epe_delta=(
+                                 args.max_stream_epe_delta))
     if args.lint_report:
         failures.extend(lint_gate(args.lint_report))
     print(json.dumps({"ok": not failures, "failures": failures,

@@ -166,9 +166,15 @@ def main(argv=None) -> int:
                                          StageSpec, run_curriculum)
 
         data_root, split = build_chairs(root, hw=(64, 96))
+        # --tiny shrinks the model knobs to the floor (1 refinement
+        # iteration, 1 corr level/radius): the smoke asserts ledger /
+        # chaos / recovery semantics, which never look inside the
+        # update operator — the extra compile time bought nothing.
+        it = 1 if args.tiny else 2
+        cl = 1 if args.tiny else 2
         manifest = Manifest(base={
-            "small": True, "iters": 2, "scan_unroll": 1,
-            "corr_levels": 2, "corr_radius": 2, "precision": "fp32",
+            "small": True, "iters": it, "scan_unroll": 1,
+            "corr_levels": cl, "corr_radius": cl, "precision": "fp32",
             "image_size": list(crop), "num_steps": steps, "val_freq": 2,
             "batch_per_chip": 1, "num_workers": 1, "device_prefetch": 2,
             "data_root": data_root, "chairs_split": split, "seed": 11,

@@ -1,4 +1,4 @@
-"""Regenerate the bundled ``demo-frames/`` sample (3 frames = 2 pairs).
+"""Regenerate the bundled ``demo-frames/`` sample (8 frames = 7 pairs).
 
 The reference ships real sample imagery (``demo-frames/`` Sintel stills
 and the fork's ``data_abel/`` street pair, reference demo.py:69,77-78);
@@ -6,23 +6,78 @@ this repo cannot copy those, so it bundles a PROCEDURAL street-like
 scene instead: sky gradient, panning textured ground, parallax skyline,
 independently moving circles, and a crossing "car" — enough structure
 for RAFT to produce a readable colorwheel flow image in a bare clone.
+The clip is long enough (>= 8 frames) to exercise the streaming
+session API (docs/SERVING.md "Streaming sessions").
+
+:func:`make_clip` is the importable, cv2-free variant with EXACTLY
+known motion — a smooth texture rolled by a fixed shift per frame, so
+every pixel's ground-truth flow is the shift itself.  The streaming
+e2e test (tests/test_serve_stream.py) and ``scripts/bench_stream.py
+--tiny`` measure EPE against it.
 
 Deterministic (fixed seeds).  Usage:
-    python scripts/make_demo_frames.py [outdir=demo-frames]
+    python scripts/make_demo_frames.py [outdir=demo-frames] [n_frames=8]
 """
 
 from __future__ import annotations
 
 import sys
 
-import cv2
 import numpy as np
 
 H, W = 384, 512
 
 
+def _upsample_bilinear(base: np.ndarray, factor: int) -> np.ndarray:
+    """Pure-numpy separable bilinear upsample of ``(h, w, c)`` — keeps
+    :func:`make_clip` importable without cv2."""
+    h, w, _ = base.shape
+    ys = np.linspace(0, h - 1, h * factor)
+    xs = np.linspace(0, w - 1, w * factor)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    fy = (ys - y0)[:, None, None]
+    fx = (xs - x0)[None, :, None]
+    a, b = base[y0][:, x0], base[y0][:, x1]
+    c, d = base[y1][:, x0], base[y1][:, x1]
+    return (1 - fy) * ((1 - fx) * a + fx * b) \
+        + fy * ((1 - fx) * c + fx * d)
+
+
+def make_clip(n_frames: int = 8, hw=(H, W), shift=(2, 1),
+              seed: int = 3):
+    """Synthetic streaming clip with exactly-known analytic motion.
+
+    A smooth random texture (bilinear-upsampled low-frequency noise, so
+    RAFT has trackable gradients) is rolled by ``shift`` = ``(dx, dy)``
+    pixels per frame with wrap-around: every pixel of every consecutive
+    pair has TRUE flow exactly ``shift``.
+
+    Returns ``(frames, flow)``: ``frames`` float32 ``(n, H, W, 3)`` in
+    [0, 255]; ``flow`` float32 ``(H, W, 2)``, last axis ``(x, y)`` —
+    the ground truth of every consecutive pair.
+    """
+    h, w = hw
+    dx, dy = shift
+    rng = np.random.default_rng(seed)
+    base = rng.uniform(0, 255, (h // 4 + 2, w // 4 + 2, 3))
+    tex = _upsample_bilinear(base, 4)[:h, :w]
+    frames = np.stack([
+        np.roll(np.roll(tex, t * dy, axis=0), t * dx, axis=1)
+        for t in range(n_frames)]).astype(np.float32)
+    flow = np.zeros((h, w, 2), np.float32)
+    flow[..., 0] = dx
+    flow[..., 1] = dy
+    return frames, flow
+
+
 def main():
+    import cv2
+
     out = sys.argv[1] if len(sys.argv) > 1 else "demo-frames"
+    n_frames = int(sys.argv[2]) if len(sys.argv) > 2 else 8
     import os
 
     os.makedirs(out, exist_ok=True)
@@ -63,10 +118,10 @@ def main():
         img += np.random.default_rng(100).normal(0, 3, img.shape)
         return np.clip(img, 0, 255).astype(np.uint8)
 
-    for t in range(3):
+    for t in range(n_frames):
         cv2.imwrite(f"{out}/frame_{t:04d}.png",
                     cv2.cvtColor(scene(t), cv2.COLOR_RGB2BGR))
-    print(f"wrote 3 frames to {out}/")
+    print(f"wrote {n_frames} frames to {out}/")
 
 
 if __name__ == "__main__":

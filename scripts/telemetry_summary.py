@@ -81,11 +81,17 @@ def last_run(records):
     ``quality`` collects the flow-quality stream
     (``quality_score``/``quality_drift`` events,
     ``raft_tpu/obs/quality.py``) over the whole log like ``faults`` —
-    drift that fired before the last restart is still drift."""
+    drift that fired before the last restart is still drift.
+
+    ``retires`` collects ``serve_retire`` iteration counts over the
+    whole log, split by the event's ``warm`` tag (streaming warm-start
+    frames vs cold admissions, docs/SERVING.md "Streaming sessions") —
+    the split is what makes the warm saving visible in a summary."""
     run_cfg, steps, health, spans, costs = None, [], [], [], []
     faults = {"sample_quarantine": 0, "ckpt_fallback": 0,
               "serve_retry": 0, "chaos_inject": 0}
     quality = {"scores": [], "drifts": []}
+    retires = {"warm": [], "cold": []}
     for rec in records:
         ev = rec.get("event")
         if ev == "run_config":
@@ -102,6 +108,11 @@ def last_run(records):
             quality["scores"].append(rec)
         elif ev == "quality_drift":
             quality["drifts"].append(rec)
+        elif ev == "serve_retire":
+            it = rec.get("iters")
+            if isinstance(it, (int, float)):
+                retires["warm" if rec.get("warm")
+                        else "cold"].append(int(it))
         elif ev == "metrics_summary":
             # The run's final raft_cost_mfu gauge values ride along as
             # a synthetic record so summarize() folds them next to the
@@ -112,7 +123,8 @@ def last_run(records):
                 costs.append({"_mfu_gauge": vals})
         elif ev in faults:
             faults[ev] += 1
-    return run_cfg, steps, health, faults, spans, costs, quality
+    return (run_cfg, steps, health, faults, spans, costs, quality,
+            retires)
 
 
 def _wait_s(rec):
@@ -230,8 +242,29 @@ def quality_summary(quality):
     return out
 
 
+def retire_summary(retires):
+    """Fold ``serve_retire`` iteration counts, split by the ``warm``
+    tag, into config-block fields — p50/p95/n per class plus
+    ``warm_iters_saved_frac`` (1 - warm p50 / cold p50, the same figure
+    ``scripts/bench_stream.py`` records and
+    ``check_regression.py --min-warm-iters-saved-frac`` gates on).
+    Returns ``{}`` for logs without retirements (training logs, old
+    serve logs) — they summarize unchanged."""
+    if not retires or not (retires.get("warm") or retires.get("cold")):
+        return {}
+    out = {"serve_iters_used": {
+        k: {"p50": _pctl(v, 0.50), "p95": _pctl(v, 0.95), "n": len(v)}
+        for k, v in sorted(retires.items()) if v}}
+    warm, cold = retires.get("warm"), retires.get("cold")
+    if warm and cold:
+        w50, c50 = _pctl(warm, 0.50), _pctl(cold, 0.50)
+        if c50 > 0:
+            out["warm_iters_saved_frac"] = round(1.0 - w50 / c50, 4)
+    return out
+
+
 def summarize(run_cfg, steps, health=None, faults=None, spans=None,
-              costs=None, quality=None, skip=2):
+              costs=None, quality=None, retires=None, skip=2):
     if run_cfg is None:
         raise SystemExit("no run_config event in log (telemetry written "
                          "by an older build?) — cannot recover batch "
@@ -285,6 +318,8 @@ def summarize(run_cfg, steps, health=None, faults=None, spans=None,
     health_cfg.update(cost_summary(costs, value))
     # Flow-quality fold (docs/OBSERVABILITY.md "Flow quality").
     health_cfg.update(quality_summary(quality))
+    # Streaming warm/cold retirement fold (docs/SERVING.md).
+    health_cfg.update(retire_summary(retires))
     last_health = (health or [None])[-1]
     if last_health is not None:
         health_cfg["nonfinite_steps_total"] = last_health.get(
@@ -319,10 +354,11 @@ def summarize(run_cfg, steps, health=None, faults=None, spans=None,
 
 def main(argv=None):
     args = parse_args(argv)
-    run_cfg, steps, health, faults, spans, costs, quality = last_run(
-        iter_records(args.path))
+    (run_cfg, steps, health, faults, spans, costs, quality,
+     retires) = last_run(iter_records(args.path))
     print(json.dumps(summarize(run_cfg, steps, health, faults, spans,
-                               costs, skip=args.skip, quality=quality)))
+                               costs, skip=args.skip, quality=quality,
+                               retires=retires)))
 
 
 if __name__ == "__main__":

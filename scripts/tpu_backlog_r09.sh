@@ -1,0 +1,69 @@
+#!/bin/bash
+# Round-9 TPU backlog, priority order: re-baseline the streaming
+# session path (docs/SERVING.md "Streaming sessions") on hardware.
+# Off-TPU the warm-start numbers come from random weights on a tiny
+# synthetic clip; this round measures the real encoder-work saving
+# (wenc vs enc in the cost ledger), the warm-vs-cold iters_used split
+# at production shapes, and the accuracy cost of forward-warp carry
+# with the real checkpoint — then arms the two streaming gates on the
+# fresh records.  Every step is independently resumable.
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+
+# 0. The streaming bench at production scale: 24-frame clips, four
+#    concurrent sessions (each pinning a slot lane), full 32-iteration
+#    cold budget with warm frames capped at 8.  The record's
+#    warm_iters_saved_frac / stream_epe_delta are the first hardware
+#    data points for the step-3 gates; encoder_flops_saved_frac is
+#    stamped from the compile-time cost ledger and should sit near the
+#    ~34% the tiny CPU run predicts.
+python scripts/bench_stream.py --hw 440x1024 --frames 24 --sessions 4 \
+    --iters 32 --stream-warm-iters 8 --slots 8 \
+    2>&1 | tee /tmp/bench_stream_r09.log | tail -1 > BENCH_STREAM_r09.json
+
+# 1. Warm-budget sweep: the tiny run pins the saving by BUDGET
+#    (stream_warm_iters < iters); on hardware the interesting number
+#    is how few iterations a warm frame needs under the in-graph
+#    early-exit predicate alone (no cap).  If the no-cap arm's warm
+#    p50 already sits well under the cold p50, serve with no cap and
+#    let convergence decide; otherwise keep the explicit budget.
+python scripts/bench_stream.py --hw 440x1024 --frames 24 --sessions 4 \
+    --iters 32 --early-exit-threshold 0.05 \
+    2>&1 | tee /tmp/bench_stream_nocap_r09.log \
+    | tail -1 > BENCH_STREAM_NOCAP_r09.json
+
+# 2. Streamed accuracy with the real checkpoint (weights-blocked
+#    off-TPU; see docs/REAL_WEIGHTS_RUNBOOK.md): the CPU stream_epe_
+#    delta is random-weights noise — the number that decides whether
+#    warm start ships is the delta with trained weights, where the
+#    forward-warped init is actually near the optimum.  Compare the
+#    streamed Sintel-clip EPE against the independent-pair arm in
+#    BENCH_STREAM_r09.json before trusting the step-3 ceiling.
+python -m raft_tpu evaluate --model checkpoints/raft --dataset sintel \
+    2>&1 | tee /tmp/eval_stream_r09.log | tail -1 > EVAL_STREAM_r09.json
+
+# 3. Arm the streaming gates against the fresh records.  Floors /
+#    ceilings are INTENTIONALLY loose on first arming (half the
+#    measured warm saving; 2x the measured EPE delta): the point this
+#    round is that the gates hold real data.  Both fail vacuously
+#    without a qualifying record, so a bench that silently skipped an
+#    arm shows up here, not in a false pass.
+python scripts/check_regression.py \
+    --min-warm-iters-saved-frac 0.15 --max-stream-epe-delta 0.5 \
+    2>&1 | tail -3
+
+# 4. Session-lifecycle soak under traced load: long-running sessions
+#    across a rolling update_weights, TTL evictions under lane
+#    pressure, and a failover cold restart — then the telemetry fold.
+#    The summary's serve_iters_used warm/cold split and the
+#    warm-tagged device spans come from the same stream, so slow AND
+#    cold-restarted frames correlate per trace tree.  Watch
+#    raft_fleet_stream_restarts_total: restarts on every update mean
+#    the generation check is too eager; zero across an update means
+#    sessions are silently serving stale weights.
+RAFT_TRACE_SAMPLE_RATE=0.1 RAFT_TELEMETRY_DIR=/tmp/telem_r09 \
+    python scripts/bench_stream.py --hw 440x1024 --frames 48 \
+    --sessions 8 --iters 32 --stream-warm-iters 8 --slots 8 \
+    2>&1 | tail -1
+python scripts/telemetry_summary.py /tmp/telem_r09 2>&1 | tail -1
+python scripts/trace_report.py /tmp/telem_r09 2>&1 | tail -20

@@ -1,0 +1,321 @@
+"""Streaming-session serving tests (tier-1).
+
+The contracts pinned here are the streaming acceptance criteria
+(docs/SERVING.md "Streaming sessions"):
+
+- **Warm start wins**: over a >= 8-frame clip with known analytic
+  motion (``scripts/make_demo_frames.make_clip``) every frame after
+  the first pair takes the warm path, the warm ``iters_used`` p50
+  sits strictly below the cold p50, and the compile ledger shows
+  exactly one ``enc`` + ``iter`` + ``stash`` + ``wenc`` program per
+  ``(bucket, slots)``.
+- **Cold parity**: a session's FIRST pair is bit-identical to the
+  stateless slot path — the cold pair runs the unmodified ``enc``
+  executable; the carry stash is a separate program.
+- **Cheaper warm encoder**: the cost book stamps ``wenc`` with fewer
+  FLOPs per pair than ``enc`` (one image encoded instead of two).
+- **Sessions are mortal**: the idle TTL evicts a session (freeing its
+  pinned lane), and a post to an evicted id transparently re-seeds.
+- **Fleet restarts are cold**: a rolling ``update_weights`` and a
+  dead replica both cold-restart the session in place (reasons
+  ``weights_update`` / ``failover``) — warm state never crosses a
+  weights generation or a replica boundary.
+
+Small model, fp32, tiny shapes — compiles stay in the fast tier.
+"""
+
+import importlib.util
+import os.path as osp
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import chaos
+from raft_tpu.config import RAFTConfig
+from raft_tpu.serve import (FleetConfig, FlowRouter, InferenceEngine,
+                            ReplicaFleet, RouterConfig, ServeConfig)
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+CFG = RAFTConfig.small_model()  # fp32 compute: bit-comparable
+ITERS = 3
+SHAPE = (36, 52)                # -> bucket (40, 56)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+class _RecordingSink:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, step=None, **fields):
+        self.events.append((event, fields))
+
+    def of(self, event):
+        return [f for e, f in self.events if e == event]
+
+
+def _make_clip(n_frames=8, seed=3):
+    spec = importlib.util.spec_from_file_location(
+        "make_demo_frames",
+        osp.join(REPO, "scripts", "make_demo_frames.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.make_clip(n_frames, SHAPE, shift=(2, 1), seed=seed)
+
+
+@pytest.fixture(scope="module")
+def variables():
+    import jax
+
+    from raft_tpu.models.raft import RAFT
+
+    img = jax.numpy.zeros((1, 40, 56, 3))
+    rng = jax.random.PRNGKey(0)
+    return RAFT(CFG).init({"params": rng, "dropout": rng},
+                          img, img, iters=1)
+
+
+# ---------------------------------------------------------------------------
+# forward_warp_flow (the warm-init operator)
+# ---------------------------------------------------------------------------
+
+
+def test_forward_warp_flow_constant_and_zero():
+    """A constant integer flow forward-warps to the SAME constant
+    everywhere it lands (pure translation transports the field), the
+    vacated strip falls back to the cold zero init, and zero flow is
+    an exact identity."""
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.sampler import forward_warp_flow
+
+    H, W = 10, 12
+    flow = jnp.zeros((1, H, W, 2))
+    np.testing.assert_array_equal(
+        np.asarray(forward_warp_flow(flow)), np.zeros((1, H, W, 2)))
+
+    const = jnp.tile(jnp.asarray([2.0, 1.0]), (1, H, W, 1))
+    warped = np.asarray(forward_warp_flow(const))[0]
+    # Landed region: rows >= 1, cols >= 2 received the splat.
+    np.testing.assert_allclose(
+        warped[1:, 2:],
+        np.broadcast_to([2.0, 1.0], warped[1:, 2:].shape), atol=1e-5)
+    # Vacated strip: nothing splatted there -> zeros (cold init).
+    np.testing.assert_array_equal(warped[0, :], 0.0)
+    np.testing.assert_array_equal(warped[:, :2][1:], 0.0)
+
+
+def test_forward_warp_flow_out_of_frame_drops():
+    """Flow pointing entirely out of frame leaves an all-zero field
+    (every target unhit), not NaNs or garbage."""
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.sampler import forward_warp_flow
+
+    flow = jnp.tile(jnp.asarray([1e4, 1e4]), (1, 6, 8, 1))
+    out = np.asarray(forward_warp_flow(flow))
+    np.testing.assert_array_equal(out, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# engine: streaming e2e + ledger + parity + cost
+# ---------------------------------------------------------------------------
+
+
+def test_stream_e2e_warm_ledger_parity_and_cost(variables):
+    """One engine, one 8-frame clip: cold first pair bit-matches the
+    stateless path, all later frames are warm with a strictly lower
+    iters_used p50, the ledger compiled exactly one program of each
+    kind, and the cost book prices wenc under enc."""
+    frames, _gt = _make_clip(8)
+    sink = _RecordingSink()
+    eng = InferenceEngine(variables, CFG, ServeConfig(
+        iters=ITERS, batching="slot", slots=4,
+        stream_warm_iters=ITERS - 1), sink=sink)
+    with eng:
+        # Stateless oracle FIRST: same programs, one cold request.
+        ref = eng.infer(frames[0], frames[1], timeout=120)
+
+        eng.stream_open("cam0", frames[0])
+        outs = []
+        for f in frames[1:]:
+            outs.append(eng.stream_ingest("cam0", f, timeout=120))
+        summary = eng.stream_close("cam0")
+        stats = eng.stats()
+
+    # --- cold-first-pair bitwise parity with the stateless path ----
+    assert outs[0]["warm"] is False and outs[0]["frame"] == 1
+    np.testing.assert_array_equal(outs[0]["flow"], ref)
+
+    # --- every later frame is warm and produced flow ---------------
+    assert all(o["warm"] for o in outs[1:])
+    assert all(o["flow"].shape == SHAPE + (2,) for o in outs)
+    assert all(np.isfinite(o["flow"]).all() for o in outs)
+    assert summary["frames"] == 8
+    assert summary["pairs"] == 7
+    assert summary["warm_pairs"] == 6
+
+    # --- compile ledger: one program each ---------------------------
+    counts = eng.compile_counter.counts()
+    assert counts == {((40, 56), 4, "enc"): 1,
+                      ((40, 56), 4, "iter"): 1,
+                      ((40, 56), 4, "stash"): 1,
+                      ((40, 56), 4, "wenc"): 1}, counts
+
+    # --- warm p50 strictly below cold p50 ---------------------------
+    warm, cold = stats["iters_used_warm"], stats["iters_used_cold"]
+    assert warm["count_total"] == 6
+    assert cold["count_total"] == 2  # oracle request + session pair 0
+    assert warm["p50"] < cold["p50"], (warm, cold)
+
+    # --- warm encoder is cheaper in the compile-time cost model -----
+    enc = stats["cost"]["40x56/b4/enc"]
+    wenc = stats["cost"]["40x56/b4/wenc"]
+    assert wenc["flops_per_pair"] < enc["flops_per_pair"], (enc, wenc)
+
+    # --- events carry the warm split --------------------------------
+    retire_warm = [f["warm"] for f in sink.of("serve_retire")]
+    assert retire_warm.count(True) == 6
+    admits = sink.of("serve_admit")
+    assert {a["warm"] for a in admits} == {True, False}
+    assert sink.of("stream_open")[0]["sid"] == "cam0"
+    assert sink.of("stream_close")[0]["warm_pairs"] == 6
+
+    # --- stats session block (the counter tallies INGESTED frames —
+    # the ones that did device work; the seed frame is host-side) ----
+    assert stats["sessions"]["frames_total"] == 7
+
+
+def test_stream_ttl_eviction_and_reseed(variables):
+    """An idle session is evicted at its TTL (event + counter + freed
+    pin); a later post to the same id transparently re-opens it as a
+    fresh frame-0 seed instead of erroring."""
+    frames, _gt = _make_clip(3, seed=5)
+    sink = _RecordingSink()
+    eng = InferenceEngine(variables, CFG, ServeConfig(
+        iters=ITERS, batching="slot", slots=2, stream_ttl_s=0.3),
+        sink=sink)
+    with eng:
+        out = eng.stream_ingest("cam0", frames[0], timeout=120)
+        assert out["frame"] == 0 and out["flow"] is None
+        out = eng.stream_ingest("cam0", frames[1], timeout=120)
+        assert out["frame"] == 1 and out["flow"] is not None
+
+        # Expire: the dispatcher's TTL sweep keeps running while the
+        # pool is otherwise idle (pinned-lane poll).
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if eng.stats()["sessions"]["open"] == 0:
+                break
+            time.sleep(0.05)
+        stats = eng.stats()
+        assert stats["sessions"]["open"] == 0
+        assert stats["sessions"]["evicted_total"] == 1
+        ev = sink.of("stream_evict")
+        assert len(ev) == 1 and ev[0]["sid"] == "cam0"
+        assert ev[0]["idle_s"] >= 0.3 and ev[0]["lane"] >= 0
+
+        # Re-seed: unknown id again -> frame 0, no flow, then warmable.
+        out = eng.stream_ingest("cam0", frames[1], timeout=120)
+        assert out["frame"] == 0 and out["flow"] is None
+        out = eng.stream_ingest("cam0", frames[2], timeout=120)
+        assert out["frame"] == 1 and out["flow"] is not None
+        eng.stream_close("cam0")
+
+
+# ---------------------------------------------------------------------------
+# fleet: weight updates and failover cold-restart the session
+# ---------------------------------------------------------------------------
+
+
+def test_stream_survives_update_weights_and_failover(variables,
+                                                     tmp_path):
+    """The two fleet drills on one fleet: (1) a rolling weight update
+    cold-restarts the session in place — the next frame re-seeds under
+    the new weights (reason ``weights_update``) and the stream then
+    resumes warm; (2) the owner replica dying mid-stream fails the
+    next frame over to the sibling as a cold restart (reason
+    ``failover``) without surfacing an error to the client."""
+    import jax
+
+    from raft_tpu.models.raft import RAFT
+
+    frames, _gt = _make_clip(8, seed=7)
+    sink = _RecordingSink()
+    scfg = ServeConfig(iters=ITERS, batching="slot", slots=2,
+                       stream_warm_iters=ITERS - 1)
+    # Long health poll: drill (2) needs the router's OWN failover path
+    # to see the dead engine before the supervisor does.
+    fleet = ReplicaFleet(variables, CFG, scfg, FleetConfig(
+        replicas=2, aot_dir=str(tmp_path), auto_export_aot=False,
+        warmup_shapes=(), restart_backoff_s=0.05, health_poll_s=5.0))
+    fleet.start()
+    try:
+        router = FlowRouter(fleet, RouterConfig(), sink=sink)
+
+        out = router.stream_ingest("cam0", frames[0], timeout=120)
+        assert out["frame"] == 0 and out["flow"] is None
+        out = router.stream_ingest("cam0", frames[1], timeout=120)
+        assert out["frame"] == 1 and out["warm"] is False
+        out = router.stream_ingest("cam0", frames[2], timeout=120)
+        assert out["frame"] == 2 and out["warm"] is True
+
+        # ---- drill 1: rolling update -> cold restart ---------------
+        k = jax.random.PRNGKey(9)
+        img = jax.numpy.zeros((1, 40, 56, 3))
+        new_vars = jax.device_get(RAFT(CFG).init(
+            {"params": k, "dropout": k}, img, img, iters=1))
+        assert fleet.update_weights(new_vars)["ok"]
+
+        out = router.stream_ingest("cam0", frames[3], timeout=120)
+        # The restart replayed frame 2 as the new seed, so frame 3
+        # still produces a pair — cold, under the NEW weights.
+        assert out["frame"] == 3 and out["flow"] is not None
+        assert out["warm"] is False
+        rst = sink.of("stream_restart")
+        assert len(rst) == 1 and rst[0]["reason"] == "weights_update"
+
+        out = router.stream_ingest("cam0", frames[4], timeout=120)
+        assert out["frame"] == 4 and out["warm"] is True
+
+        # ---- drill 2: owner dies -> failover cold restart ----------
+        # The death must strike DURING the engine call so the router's
+        # pre-flight eligibility check passes and the in-call exception
+        # path fires (reason "failover") — an engine stopped up front
+        # is caught pre-flight as "replica_lost" instead, and a chaos
+        # replica_kill races the dispatcher's idle pin-sweep cycles.
+        # A one-shot raising wrapper is the deterministic equivalent.
+        from raft_tpu.chaos import InjectedReplicaKill
+
+        owner_name = rst[0]["to_replica"]
+        owner = next(r for r in fleet.replicas
+                     if r.name == owner_name)
+
+        def _die(*a, **kw):
+            raise InjectedReplicaKill("test-injected owner death")
+
+        owner.engine.stream_ingest = _die
+        out = router.stream_ingest("cam0", frames[5], timeout=120)
+        assert out["frame"] == 5 and out["flow"] is not None
+        assert out["warm"] is False  # cold restart on the sibling
+        rst = sink.of("stream_restart")
+        assert len(rst) == 2 and rst[1]["reason"] == "failover"
+        assert rst[1]["to_replica"] != owner_name
+
+        out = router.stream_ingest("cam0", frames[6], timeout=120)
+        assert out["frame"] == 6 and out["warm"] is True
+
+        summary = router.stream_close("cam0")
+        assert summary["restarts"] == 2
+        rstats = router.router_stats()
+        assert rstats["stream_restarts_total"] == 2
+        assert rstats["streams_open"] == 0
+    finally:
+        fleet.stop(drain=False)

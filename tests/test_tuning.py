@@ -171,11 +171,11 @@ def test_run_config_carries_tuning_stamp(tmp_path):
     telem.record_step(step=1, step_time_s=0.5, queue_wait_s=0.0)
     telem.sink.close()
     ts = _load_script("telemetry_summary")
-    run_cfg, steps, health, faults, spans, costs, quality = ts.last_run(
-        ts.iter_records(str(tmp_path)))
+    (run_cfg, steps, health, faults, spans, costs, quality,
+     retires) = ts.last_run(ts.iter_records(str(tmp_path)))
     assert run_cfg["tuned"] is True
     out = ts.summarize(run_cfg, steps, health, faults, spans, costs,
-                       quality, skip=0)
+                       quality, retires, skip=0)
     assert out["config"]["tuned"] is True
     assert out["config"]["tuning_key"] == "train|cpu|x|b4"
     assert out["config"]["tuning_registry_hash"] == "abc"
