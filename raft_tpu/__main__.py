@@ -32,6 +32,8 @@ _SUBCOMMANDS = {
              "raftlint static analysis (docs/ANALYSIS.md)"),
     "cost": ("raft_tpu.cli.cost",
              "per-program FLOPs/bytes/roofline cost table"),
+    "incidents": ("raft_tpu.cli.incidents",
+                  "list / show / timeline over incident bundles"),
 }
 
 

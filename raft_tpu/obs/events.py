@@ -18,15 +18,36 @@ One record per event, one line per record, append-only::
 The file is opened line-buffered, so every record is one ``write``
 syscall and a crashed run keeps everything up to its last event —
 microseconds per event, never a device sync.
+
+Size-capped rotation (``RAFT_TELEMETRY_MAX_MB``, default off): always-on
+flight recording (obs/incident.py) must not grow JSONL files unbounded
+on long serve runs.  With a cap, the live file rotates at a quarter of
+the budget to ``telemetry-p<i>-r<seq>.jsonl`` and the three newest
+rotated segments are kept (older ones deleted), bounding total disk at
+~the cap.  ``-`` sorts before ``.``, so the sorted ``*.jsonl`` glob in
+``telemetry_summary.py`` / ``trace_report.py`` still yields segments in
+chronological order — the reader contract is unchanged.
+
+Observers (:meth:`EventSink.add_observer`) see every record emitted —
+the incident manager's flight recorder rides here.  They are invoked
+AFTER the write lock is released, so an observer may itself emit
+through the same sink (the incident manager re-emits ``incident_*``)
+without deadlocking.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
+
+# Rotation granularity: the live segment caps at budget/4 and the 3
+# newest rotated segments are kept, so live + rotated stay ~under the
+# configured total budget.
+_ROTATE_SEGMENTS = 4
 
 
 def _process_index() -> int:
@@ -43,17 +64,38 @@ class EventSink:
     is None."""
 
     def __init__(self, directory: Optional[str] = None, *,
-                 filename: Optional[str] = None):
+                 filename: Optional[str] = None,
+                 max_bytes: Optional[int] = None):
         self._dir = directory or None
         self._filename = filename
         self._lock = threading.Lock()
         self._fh = None
         self._process: Optional[int] = None
         self.path: Optional[str] = None
+        if max_bytes is None:
+            mb = os.environ.get("RAFT_TELEMETRY_MAX_MB")
+            if mb:
+                try:
+                    max_bytes = int(float(mb) * 1024 * 1024)
+                except ValueError:
+                    max_bytes = None
+        self._max_bytes = max_bytes if max_bytes and max_bytes > 0 \
+            else None
+        self._bytes = 0
+        self._rot_seq = 0
+        self._observers: tuple = ()
 
     @classmethod
     def from_env(cls) -> "EventSink":
         return cls(os.environ.get("RAFT_TELEMETRY_DIR") or None)
+
+    def add_observer(self, fn: Callable[[dict], None]) -> None:
+        """Register ``fn(record)`` to see every emitted record.  Called
+        OUTSIDE the write lock (an observer may emit through this same
+        sink); observer errors are swallowed — telemetry consumers must
+        never take down the producer."""
+        with self._lock:
+            self._observers = self._observers + (fn,)
 
     @property
     def enabled(self) -> bool:
@@ -74,7 +116,53 @@ class EventSink:
             name = self._filename or f"telemetry-p{self._process}.jsonl"
             self.path = os.path.join(self._dir, name)
             self._fh = open(self.path, "a", buffering=1)
+            try:
+                self._bytes = os.path.getsize(self.path)
+            except OSError:
+                self._bytes = 0
+            if self._max_bytes is not None and self._rot_seq == 0:
+                # Continue segment numbering across reopens/restarts.
+                existing = sorted(glob.glob(
+                    self._rotated_glob_locked()))
+                if existing:
+                    tail = existing[-1].rsplit("-r", 1)[-1]
+                    try:
+                        self._rot_seq = int(tail.split(".")[0]) + 1
+                    except ValueError:
+                        self._rot_seq = len(existing)
         return self._fh
+
+    def _rotated_glob_locked(self) -> str:
+        base = self.path[:-len(".jsonl")] if self.path else ""
+        return base + "-r*.jsonl"
+
+    def _maybe_rotate_locked(self) -> None:
+        """Rotate the live segment once it exceeds its share of the
+        budget; keep the newest rotated segments, delete the rest.
+        Rotated names (``-r<seq>``) sort BEFORE the live file (``-`` <
+        ``.``), so sorted-glob readers still see chronological order."""
+        if self._max_bytes is None or self.path is None:
+            return
+        seg_bytes = max(self._max_bytes // _ROTATE_SEGMENTS, 4096)
+        if self._bytes < seg_bytes:
+            return
+        self._fh.close()
+        self._fh = None
+        base = self.path[:-len(".jsonl")]
+        dest = f"{base}-r{self._rot_seq:06d}.jsonl"
+        self._rot_seq += 1
+        try:
+            os.replace(self.path, dest)
+        except OSError:
+            pass
+        for old in sorted(glob.glob(
+                self._rotated_glob_locked()))[:-(_ROTATE_SEGMENTS - 1)]:
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+        self._fh = open(self.path, "a", buffering=1)
+        self._bytes = 0
 
     def emit(self, event: str, step: Optional[int] = None,
              **fields) -> None:
@@ -91,7 +179,16 @@ class EventSink:
             if step is not None:
                 rec["step"] = int(step)
             rec.update(fields)
-            fh.write(json.dumps(rec, default=str) + "\n")
+            line = json.dumps(rec, default=str) + "\n"
+            fh.write(line)
+            self._bytes += len(line)
+            self._maybe_rotate_locked()
+            observers = self._observers
+        for fn in observers:
+            try:
+                fn(rec)
+            except Exception:
+                pass
 
     def flush(self) -> None:
         with self._lock:

@@ -53,6 +53,17 @@ from raft_tpu.obs.registry import MetricRegistry
 from raft_tpu.utils.profiling import CompileCounter
 
 
+def _env_float(name: str, default: float = 0.0) -> float:
+    """A float env knob; unset/empty/garbage -> ``default``."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
 class TrainTelemetry:
     def __init__(self, directory: Optional[str] = None, *,
                  batch_size: int, num_devices: int,
@@ -119,6 +130,64 @@ class TrainTelemetry:
             "(iter label; the refinement-convergence curve)")
         # Recent per-step records for the stall watchdog's post-mortem.
         self._recent: collections.deque = collections.deque(maxlen=16)
+        # Train-side SLOs + incident engine (obs/slo.py,
+        # obs/incident.py), env-driven so every train entrypoint gets
+        # them without CLI plumbing: RAFT_SLO_GOODPUT=<objective>
+        # tracks the non-quarantined non-nonfinite step fraction
+        # (fed by record_health; quarantines counted via a sink
+        # observer), RAFT_SLO_MFU_FLOOR=<floor> the per-step MFU floor
+        # (known device peaks only), RAFT_SLO_WINDOW_S rescales the
+        # burn policy, RAFT_INCIDENTS=1 builds the incident manager
+        # (RAFT_INCIDENT_WINDOW_S / _QUIET_S / _COOLDOWN_S size it).
+        # All disabled by default: nothing is constructed, the step
+        # path is untouched.
+        self._slo = None
+        self._quarantined_new = 0
+        self._last_health_step: Optional[int] = None
+        goodput = _env_float("RAFT_SLO_GOODPUT")
+        mfu_floor = _env_float("RAFT_SLO_MFU_FLOOR")
+        self._mfu_floor = None
+        if self.enabled and (goodput or mfu_floor):
+            from raft_tpu.obs import slo as slo_mod
+
+            window = _env_float("RAFT_SLO_WINDOW_S") or 3600.0
+            policy = slo_mod.scaled_policy(window)
+            specs = []
+            if goodput:
+                specs.append(slo_mod.SLOSpec(
+                    "train_goodput", goodput,
+                    "non-quarantined non-nonfinite step fraction",
+                    windows=policy))
+            if mfu_floor and (cost_mod.peak_spec().tflops or 0):
+                self._mfu_floor = mfu_floor
+                specs.append(slo_mod.SLOSpec(
+                    "train_mfu", 0.9,
+                    f"step MFU >= {mfu_floor}", windows=policy))
+            if specs:
+                self._slo = slo_mod.SLOTracker(
+                    specs, registry=self.registry, sink=self.sink)
+                self.sink.add_observer(self._count_quarantine)
+        self._incidents = None
+        if self.enabled and os.environ.get("RAFT_INCIDENTS") == "1":
+            from raft_tpu.obs import incident as incident_mod
+
+            self._incidents = incident_mod.IncidentManager(
+                registry=self.registry,
+                window_s=_env_float("RAFT_INCIDENT_WINDOW_S") or 10.0,
+                quiet_close_s=_env_float("RAFT_INCIDENT_QUIET_S")
+                or 30.0,
+                cooldown_s=_env_float("RAFT_INCIDENT_COOLDOWN_S",
+                                      60.0))
+            self._incidents.attach(self.sink)
+            self._incidents.recorder.add_provider(
+                "recent_steps", self.recent_records)
+
+    def _count_quarantine(self, rec: dict) -> None:
+        """Sink observer (SLO-enabled runs only): count quarantined
+        samples between health flushes so the goodput SLO debits them
+        alongside nonfinite steps."""
+        if rec.get("event") == "sample_quarantine":
+            self._quarantined_new += 1
 
     @property
     def directory(self) -> Optional[str]:
@@ -154,8 +223,12 @@ class TrainTelemetry:
         # MFU from the device-time proxy (step minus input wait; once
         # the pipeline fills this converges to device step time) — a
         # no-op {} until record_cost stamped the compiled step.
-        self._cost_book.observe(
+        cost_attrs = self._cost_book.observe(
             "train_step", max(step_time_s - queue_wait_s, 1e-9))
+        if (self._slo is not None and self._mfu_floor
+                and "mfu" in cost_attrs):
+            self._slo.record("train_mfu",
+                             cost_attrs["mfu"] >= self._mfu_floor)
         rec = dict(step=step,
                    step_time_s=round(step_time_s, 6),
                    queue_wait_s=round(queue_wait_s, 6),
@@ -187,6 +260,18 @@ class TrainTelemetry:
                 self._epe_iter.set(float(v), iter=f"{i:02d}")
         if nonfinite_new:
             self._nonfinite.inc(nonfinite_new)
+        if self._slo is not None:
+            # Goodput accounting per flush interval: bad = nonfinite
+            # steps + samples quarantined since the last flush; good =
+            # the rest of the interval's steps.
+            q, self._quarantined_new = self._quarantined_new, 0
+            prev, self._last_health_step = self._last_health_step, step
+            bad = int(nonfinite_new) + q
+            if bad:
+                self._slo.record("train_goodput", False, n=bad)
+            if prev is not None and step - prev - bad > 0:
+                self._slo.record("train_goodput", True,
+                                 n=step - prev - bad)
         fields = {"nonfinite_steps_total": int(nonfinite_total),
                   "nonfinite_in_interval": int(nonfinite_new)}
         if param_norm is not None:
@@ -228,6 +313,10 @@ class TrainTelemetry:
         self._cost_book.stamp("train_step", cost)
 
     def close(self) -> None:
+        if self._incidents is not None:
+            # Finalize before the summary so incident_close (and its
+            # bundle) precede the run's last record.
+            self._incidents.close()
         if self.enabled:
             self.sink.emit("metrics_summary",
                            metrics=self.registry.snapshot())

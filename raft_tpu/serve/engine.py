@@ -192,7 +192,23 @@ class ServeConfig:
     to retire early; set it below ``iters`` to cap warm frames
     outright, the streaming policy RAFT's warm-start convergence
     buys); ``max_sessions`` bounds the open-session registry (opens
-    beyond it are rejected 429-style)."""
+    beyond it are rejected 429-style).
+    SLO / incident knobs (docs/OBSERVABILITY.md "Incidents & SLOs"):
+    ``slo_availability_target`` (0 disables) is the non-error request
+    fraction objective; ``slo_latency_target_ms`` (0 disables) tracks
+    "99% of requests under this many ms"; ``slo_quality_bound``
+    (0 disables; needs ``quality_sample_rate`` > 0) marks a sampled
+    retirement bad when its photometric proxy exceeds the bound;
+    ``slo_mfu_floor`` (0 disables) marks an iteration bad below the
+    floor — constructed only when ``PEAK_SPECS`` knows the device
+    peak.  ``slo_window_s`` rescales the Google-SRE burn-rate
+    policy's 1h long window (obs/slo.py).  ``incidents`` builds an
+    :class:`~raft_tpu.obs.incident.IncidentManager` on this engine's
+    sink (leave False under a :class:`~raft_tpu.serve.fleet
+    .ReplicaFleet`, which owns ONE manager for the shared stream);
+    the ``incident_*`` knobs size its correlation window, quiet-close
+    threshold, and post-close cooldown.  All of it is host-side deque
+    arithmetic — zero device syncs, CompileCounter-pinned."""
 
     iters: int = 32
     max_batch: int = 8
@@ -224,6 +240,15 @@ class ServeConfig:
     stream_ttl_s: float = 60.0
     stream_warm_iters: Optional[int] = None
     max_sessions: int = 64
+    slo_availability_target: float = 0.0
+    slo_latency_target_ms: float = 0.0
+    slo_quality_bound: float = 0.0
+    slo_mfu_floor: float = 0.0
+    slo_window_s: float = 3600.0
+    incidents: bool = False
+    incident_window_s: float = 10.0
+    incident_quiet_s: float = 30.0
+    incident_cooldown_s: float = 60.0
 
     def __post_init__(self):
         if self.stream_ttl_s <= 0:
@@ -268,6 +293,24 @@ class ServeConfig:
             raise ValueError(
                 "need retry_backoff_max_s >= retry_backoff_s, "
                 "retry_deadline_s > 0 and 0 <= retry_jitter < 1")
+        if not 0.0 <= self.slo_availability_target < 1.0:
+            raise ValueError(
+                "slo_availability_target must be in [0, 1) — 0 "
+                f"disables, 1.0 leaves no error budget; got "
+                f"{self.slo_availability_target}")
+        if (self.slo_latency_target_ms < 0 or self.slo_quality_bound < 0
+                or self.slo_window_s <= 0):
+            raise ValueError(
+                "need slo_latency_target_ms >= 0, slo_quality_bound "
+                ">= 0 and slo_window_s > 0")
+        if not 0.0 <= self.slo_mfu_floor < 1.0:
+            raise ValueError("slo_mfu_floor must be in [0, 1) "
+                             "(0 disables)")
+        if (self.incident_window_s <= 0 or self.incident_quiet_s <= 0
+                or self.incident_cooldown_s < 0):
+            raise ValueError(
+                "need incident_window_s > 0, incident_quiet_s > 0 and "
+                "incident_cooldown_s >= 0")
         m = self.bucket_multiple
         for hw in self.buckets or ():
             if hw[0] % m or hw[1] % m:
@@ -581,6 +624,63 @@ class InferenceEngine:
         # work (obs/cost.py; docs/OBSERVABILITY.md "Cost model").
         self.cost_book = cost_mod.CostBook(registry=self.registry,
                                            sink=self._sink)
+        # SLO tracking (obs/slo.py): specs are constructed ONLY for the
+        # objectives the config enables — with every knob at 0 (the
+        # default) there is no tracker, no per-request deque append,
+        # and the hot path is byte-identical to before (the same
+        # conditional-construction pattern as the quality monitor).
+        self._slo = None
+        slo_specs = []
+        if (cfg.slo_availability_target > 0 or cfg.slo_latency_target_ms
+                > 0 or cfg.slo_quality_bound > 0 or cfg.slo_mfu_floor
+                > 0):
+            from raft_tpu.obs import slo as slo_mod
+
+            policy = slo_mod.scaled_policy(cfg.slo_window_s)
+            if cfg.slo_availability_target > 0:
+                slo_specs.append(slo_mod.SLOSpec(
+                    "availability", cfg.slo_availability_target,
+                    "non-error request fraction", windows=policy))
+            if cfg.slo_latency_target_ms > 0:
+                slo_specs.append(slo_mod.SLOSpec(
+                    "latency", 0.99,
+                    f"requests under {cfg.slo_latency_target_ms}ms",
+                    windows=policy))
+            if cfg.slo_quality_bound > 0 and cfg.quality_sample_rate > 0:
+                slo_specs.append(slo_mod.SLOSpec(
+                    "quality", 0.99,
+                    f"sampled photometric proxy <= "
+                    f"{cfg.slo_quality_bound}", windows=policy))
+            if cfg.slo_mfu_floor > 0 and (
+                    cost_mod.peak_spec().tflops or 0):
+                # MFU floor only when PEAK_SPECS knows this device's
+                # peak — on cpu/unknown kinds MFU is undefined and the
+                # spec is silently skipped.
+                slo_specs.append(slo_mod.SLOSpec(
+                    "mfu", 0.9, f"iter MFU >= {cfg.slo_mfu_floor}",
+                    windows=policy))
+            if slo_specs:
+                self._slo = slo_mod.SLOTracker(
+                    slo_specs, registry=self.registry, sink=self._sink)
+        # Incident correlation (obs/incident.py): one manager per
+        # telemetry stream — a fleet builds its engines with
+        # incidents=False and owns the manager itself (the engines
+        # share its sink, and N observers would open N incidents for
+        # one cascade).
+        self._incidents = None
+        if cfg.incidents:
+            from raft_tpu.obs import incident as incident_mod
+
+            self._incidents = incident_mod.IncidentManager(
+                registry=self.registry,
+                window_s=cfg.incident_window_s,
+                quiet_close_s=cfg.incident_quiet_s,
+                cooldown_s=cfg.incident_cooldown_s)
+            self._incidents.attach(self._sink)
+            self._incidents.recorder.add_provider("engine_stats",
+                                                  self.stats)
+            self._incidents.recorder.add_provider(
+                "serve_config", lambda: dataclasses.asdict(self.cfg))
         self._pending_gauge = self.registry.gauge(
             "raft_serve_pending_requests", "requests in flight")
         self.registry.add_collect_hook(self._collect_pending)
@@ -734,6 +834,10 @@ class InferenceEngine:
         self._thread = None
         self._dispatchers.clear()
         self._queues.clear()
+        if self._incidents is not None:
+            # Finalize: an incident still open at shutdown closes with
+            # its bundle written (close_reason="finalized").
+            self._incidents.close()
 
     def __enter__(self) -> "InferenceEngine":
         return self.start()
@@ -1170,6 +1274,14 @@ class InferenceEngine:
             for (hw, bs, prog), c in sorted(
                 self.cost_book.table().items())
         }
+        # SLO / incident snapshots (obs/slo.py, obs/incident.py): live
+        # burn rates and the open-incident id ride /v1/stats; disabled
+        # markers keep the key shape stable for clients.
+        out["slo"] = (self._slo.snapshot() if self._slo is not None
+                      else {"enabled": False})
+        out["incidents"] = (self._incidents.snapshot()
+                            if self._incidents is not None
+                            else {"enabled": False})
         return out
 
     # ------------------------------------------------------------------
@@ -1547,6 +1659,21 @@ class InferenceEngine:
             raise InjectedReplicaKill(
                 f"chaos-injected replica kill (batch {seq})")
 
+    def _slo_request(self, ok: bool,
+                     latency_s: Optional[float] = None,
+                     n: int = 1) -> None:
+        """Feed ``n`` request outcomes into the SLO tracker (callers
+        gate on ``self._slo`` so the disabled path stays untouched).
+        The latency SLO only observes SUCCESSFUL requests — an errored
+        request already burned the availability budget, and a latency
+        observation for it would double-count the failure."""
+        self._slo.record("availability", ok, n=n)
+        if ok and latency_s is not None:
+            self._slo.record(
+                "latency",
+                latency_s * 1000.0 <= self.cfg.slo_latency_target_ms,
+                n=n)
+
     def _run_batch(self, bucket: tuple, reqs: List[_Request]) -> None:
         n = len(reqs)
         bs = next((s for s in self._batch_sizes if s >= n), n)
@@ -1574,6 +1701,8 @@ class InferenceEngine:
                 r.future.set_result(
                     np.asarray(r.padder.unpad(flow_up[j:j + 1])[0]))
                 self._latency.record(t_done - r.t_submit)
+                if self._slo is not None:
+                    self._slo_request(True, t_done - r.t_submit)
             self._counters.add_batch(real=n, padded=bs - n, failed=False)
             self._sink.emit("serve_batch",
                             bucket=f"{bucket[0]}x{bucket[1]}", real=n,
@@ -1602,6 +1731,8 @@ class InferenceEngine:
             # (as failed_lanes) or occupancy/mean_batch_fill read too
             # healthy under errors — see Counters.add_batch.
             self._counters.add_batch(real=n, padded=bs - n, failed=True)
+            if self._slo is not None:
+                self._slo_request(False, n=n)
             self._sink.emit("serve_batch_error",
                             bucket=f"{bucket[0]}x{bucket[1]}", real=n,
                             error=f"{type(e).__name__}: {e}")
@@ -1652,6 +1783,8 @@ class InferenceEngine:
                     r.future.set_exception(e)
             if live:
                 self._counters.add_failed_lanes(len(live))
+                if self._slo is not None:
+                    self._slo_request(False, n=len(live))
                 with self._pending_lock:
                     self._pending -= len(live)
             pool.reset()
@@ -1789,6 +1922,8 @@ class InferenceEngine:
                 if not r.future.done():
                     r.future.set_exception(e)
             self._counters.add_failed_lanes(len(admits))
+            if self._slo is not None:
+                self._slo_request(False, n=len(admits))
             self._sink.emit("serve_admit_error",
                             bucket=f"{bucket[0]}x{bucket[1]}",
                             admits=len(admits), warm=False,
@@ -1895,6 +2030,8 @@ class InferenceEngine:
                 if r.session is not None:
                     r.session.carry_ok = False
             self._counters.add_failed_lanes(len(admits))
+            if self._slo is not None:
+                self._slo_request(False, n=len(admits))
             self._sink.emit("serve_admit_error",
                             bucket=f"{H}x{W}",
                             admits=len(admits), warm=True,
@@ -1949,6 +2086,8 @@ class InferenceEngine:
                 if not r.future.done():
                     r.future.set_exception(e)
             self._counters.add_failed_lanes(len(live))
+            if self._slo is not None and live:
+                self._slo_request(False, n=len(live))
             self._sink.emit("serve_iter_error",
                             bucket=f"{bucket[0]}x{bucket[1]}",
                             lanes=len(live),
@@ -1972,6 +2111,11 @@ class InferenceEngine:
         # raft_cost_mfu/raft_cost_hbm_bw_util gauges, no device work.
         iter_attrs = self.cost_book.observe(
             (bucket, self.cfg.slots, "iter"), t_done - t0)
+        if self._slo is not None and "mfu" in iter_attrs:
+            # The MFU-floor SLO (only constructed on known peaks):
+            # one observation per measured iteration.
+            self._slo.record(
+                "mfu", iter_attrs["mfu"] >= self.cfg.slo_mfu_floor)
         for i in np.nonzero(prev_active)[0]:
             r = pool.reqs[int(i)]
             if r is not None and r.trace is not None:
@@ -2003,6 +2147,8 @@ class InferenceEngine:
             (self._iters_used_warm if r.warm
              else self._iters_used_cold).record(used)
             self._counters.add_completed()
+            if self._slo is not None:
+                self._slo_request(True, t_done - r.t_submit)
             if r.session is not None:
                 r.session.pairs += 1
                 if r.warm:
@@ -2017,6 +2163,15 @@ class InferenceEngine:
                     future=r.future, image1=r.image1, image2=r.image2,
                     flow=out, bucket=bk, residual=float(dmax_np[i]),
                     converged=bool(converged_np[i]), iters=used)
+                if (self._slo is not None and qattrs is not None
+                        and "quality_photometric" in qattrs):
+                    # Quality SLO: one observation per SCORED
+                    # retirement — bad when the photometric proxy
+                    # breached its calibrated bound.
+                    self._slo.record(
+                        "quality",
+                        qattrs["quality_photometric"]
+                        <= self.cfg.slo_quality_bound)
                 if qattrs is not None and self.cfg.quality_cycle:
                     # Sampled forward-backward pass: score THIS flow
                     # against a second inference on the swapped

@@ -262,11 +262,18 @@ class Replica:
         with self._lock:
             return time.monotonic() < self._broken_until
 
-    def note_failure(self, threshold: int, cooldown_s: float) -> None:
+    def note_failure(self, threshold: int, cooldown_s: float) -> bool:
+        """One failover-class strike.  Returns True when THIS strike
+        transitioned the breaker from closed to open — the caller
+        (router) emits the ``fleet_breaker_open`` event exactly once
+        per open, not once per strike."""
         with self._lock:
+            was_open = time.monotonic() < self._broken_until
             self._consec_failures += 1
             if self._consec_failures >= threshold:
                 self._broken_until = time.monotonic() + cooldown_s
+                return not was_open
+            return False
 
     def note_success(self) -> None:
         with self._lock:
@@ -343,6 +350,31 @@ class ReplicaFleet:
         self._version_gauge = self.registry.gauge(
             "raft_fleet_weights_version", "serving weights version")
         self.registry.add_collect_hook(self._collect)
+        # Incident correlation (obs/incident.py): the fleet owns ONE
+        # manager observing the SHARED sink — every engine's
+        # _LabeledSink writes through it, so a cross-replica cascade
+        # (kill on r0, retries on r1) correlates into one incident.
+        # _build_engine forces incidents=False on the engines for the
+        # same reason: N engine-level observers on one stream would
+        # open N incidents for one cascade.
+        self._incidents = None
+        if serve_cfg.incidents:
+            from raft_tpu.obs import incident as incident_mod
+
+            self._incidents = incident_mod.IncidentManager(
+                registry=self.registry,
+                window_s=serve_cfg.incident_window_s,
+                quiet_close_s=serve_cfg.incident_quiet_s,
+                cooldown_s=serve_cfg.incident_cooldown_s)
+            self._incidents.attach(self._sink)
+            self._incidents.recorder.add_provider("fleet_stats",
+                                                  self.stats)
+            self._incidents.recorder.add_provider(
+                "serve_config",
+                lambda: dataclasses.asdict(self.serve_cfg))
+            self._incidents.recorder.add_provider(
+                "fleet_config",
+                lambda: dataclasses.asdict(self.fleet_cfg))
 
     def _collect(self, _reg) -> None:
         states: Dict[str, int] = {}
@@ -360,7 +392,8 @@ class ReplicaFleet:
                       replica: str = "?") -> InferenceEngine:
         with self._var_lock:
             v = self._variables if variables is None else variables
-        cfg = dataclasses.replace(self.serve_cfg, aot_dir=self.aot_dir)
+        cfg = dataclasses.replace(self.serve_cfg, aot_dir=self.aot_dir,
+                                  incidents=False)
         return InferenceEngine(v, self.model_cfg, cfg,
                                sink=_LabeledSink(self._sink,
                                                  replica=replica))
@@ -417,6 +450,8 @@ class ReplicaFleet:
             if eng is not None:
                 eng.stop(drain=drain, timeout=timeout)
         self._sink.emit("fleet_stop")
+        if self._incidents is not None:
+            self._incidents.close()
 
     def __enter__(self) -> "ReplicaFleet":
         return self.start()
@@ -451,6 +486,11 @@ class ReplicaFleet:
                         > self.fleet_cfg.backoff_reset_s):
                     r.backoff_level = 0
                 self._note_quality_drift(r, eng)
+            if self._incidents is not None:
+                # Quiet-close poll: an incident over a stream that went
+                # silent still closes (and writes its final bundle)
+                # without waiting for another event.
+                self._incidents.poll()
 
     def _note_quality_drift(self, r: Replica,
                             eng: InferenceEngine) -> None:
@@ -837,6 +877,9 @@ class ReplicaFleet:
                 "restarts_total": int(sum(
                     v for _, v in self._restarts.items())),
                 "aot_dir": self.aot_dir,
+                "incidents": (self._incidents.snapshot()
+                              if self._incidents is not None
+                              else {"enabled": False}),
             },
             "replicas": reps,
         }

@@ -245,6 +245,31 @@ class FlowRouter:
             "raft_fleet_stream_restarts_total",
             "streaming sessions cold-restarted on a new engine "
             "(weight update or failover)")
+        # Fleet-level SLOs (obs/slo.py): the router sees the outcome
+        # the CLIENT sees — post-failover, post-hedge — so fleet
+        # availability/latency live here, distinct from the per-engine
+        # trackers (pre-failover, replica-labeled).  Same conditional-
+        # construction contract: every knob 0 (default) builds nothing.
+        self._slo = None
+        scfg = fleet.serve_cfg
+        if scfg.slo_availability_target > 0 or \
+                scfg.slo_latency_target_ms > 0:
+            from raft_tpu.obs import slo as slo_mod
+
+            policy = slo_mod.scaled_policy(scfg.slo_window_s)
+            specs = []
+            if scfg.slo_availability_target > 0:
+                specs.append(slo_mod.SLOSpec(
+                    "fleet_availability", scfg.slo_availability_target,
+                    "non-error request fraction the client sees "
+                    "(post-failover)", windows=policy))
+            if scfg.slo_latency_target_ms > 0:
+                specs.append(slo_mod.SLOSpec(
+                    "fleet_latency", 0.99,
+                    f"client-observed requests under "
+                    f"{scfg.slo_latency_target_ms}ms", windows=policy))
+            self._slo = slo_mod.SLOTracker(
+                specs, registry=self.registry, sink=self._sink)
 
     # ------------------------------------------------------------------
     # client API (any thread)
@@ -493,8 +518,7 @@ class FlowRouter:
                     self._settle_or_raise(req, e, initial)
                     return
                 req.last_exc = e
-                replica.note_failure(self.cfg.breaker_threshold,
-                                     self.cfg.breaker_cooldown_s)
+                self._strike(replica)
                 continue
             self._requests.inc(replica=replica.name)
             if initial:
@@ -504,6 +528,32 @@ class FlowRouter:
                 lambda f, r=replica, g=gen, a=att:
                     self._on_done(req, r, g, f, span=a))
             return
+
+    def _strike(self, replica) -> None:
+        """One failover-class breaker strike; emits
+        ``fleet_breaker_open`` exactly on the closed->open transition."""
+        if replica.note_failure(self.cfg.breaker_threshold,
+                                self.cfg.breaker_cooldown_s):
+            self._sink.emit("fleet_breaker_open", replica=replica.name,
+                            cooldown_s=self.cfg.breaker_cooldown_s)
+
+    def _slo_done(self, ok: bool,
+                  latency_s: Optional[float] = None,
+                  exc: Optional[BaseException] = None) -> None:
+        """Feed the CLIENT-visible outcome of one settled request.
+        Load-shed rejections (429-class QueueFullError) spend no
+        availability budget — shedding under overload is the mechanism
+        PROTECTING the SLO, not a violation of it."""
+        if self._slo is None:
+            return
+        if exc is not None and isinstance(exc, QueueFullError):
+            return
+        self._slo.record("fleet_availability", ok)
+        if ok and latency_s is not None:
+            self._slo.record(
+                "fleet_latency",
+                latency_s * 1000.0
+                <= self.fleet.serve_cfg.slo_latency_target_ms)
 
     def _terminal(self, req: _RoutedRequest, saw_full, initial: bool):
         """No replica left to try: fail the request loudly."""
@@ -533,8 +583,11 @@ class FlowRouter:
                          initial: bool) -> None:
         if initial:
             req._cancel_timer()
+            self._slo_done(False, exc=exc)
             raise exc
-        if not req.settle_exception(exc) and not req.future.done():
+        if req.settle_exception(exc):
+            self._slo_done(False, exc=exc)
+        elif not req.future.done():
             # Unreachable by construction; the tripwire exists so a
             # future regression shows up as a nonzero counter in the
             # drill instead of a hung client.
@@ -598,8 +651,9 @@ class FlowRouter:
                 span.end(status="ok", won=not req.future.done())
             replica.note_success()
             if req.settle_result(inner.result()):
-                self._latency.record(
-                    time.perf_counter() - req.t_submit)
+                lat = time.perf_counter() - req.t_submit
+                self._latency.record(lat)
+                self._slo_done(True, lat)
                 if hedge:
                     self._hedge_wins.inc()
             return
@@ -610,8 +664,7 @@ class FlowRouter:
             # engine generation we dispatched to (a restarted engine
             # must not inherit its predecessor's strikes).
             if replica.generation == generation:
-                replica.note_failure(self.cfg.breaker_threshold,
-                                     self.cfg.breaker_cooldown_s)
+                self._strike(replica)
             req.last_exc = exc
             if not req.future.done():
                 self._failovers.inc(replica=replica.name)
@@ -622,7 +675,8 @@ class FlowRouter:
                     error=f"{type(exc).__name__}: {str(exc)[:200]}")
                 self._dispatch(req, initial=False)
             return
-        req.settle_exception(exc)
+        if req.settle_exception(exc):
+            self._slo_done(False, exc=exc)
 
     # ------------------------------------------------------------------
     # introspection (the HTTP edge serves a router exactly like a bare
@@ -653,6 +707,8 @@ class FlowRouter:
             "streams_open": len(self._streams),
             "stream_restarts_total": int(
                 self._stream_restarts.value()),
+            "slo": (self._slo.snapshot() if self._slo is not None
+                    else {"enabled": False}),
         }
 
     def stats(self) -> dict:

@@ -184,6 +184,30 @@ def parse_args(argv=None):
                         "slip through either).  Also fails when NO "
                         "record carries flops_per_pair (unset = no "
                         "check)")
+    p.add_argument("--max-incidents", action="append", default=[],
+                   metavar="SEV:N",
+                   help="fail when a newest record's "
+                        "config.incidents[SEV] (correlated incidents "
+                        "opened at peak severity SEV — info/warning/"
+                        "critical — from scripts/telemetry_summary.py "
+                        "or scripts/incident_smoke.py; "
+                        "docs/OBSERVABILITY.md 'Incidents & SLOs') "
+                        "exceeds N; repeatable.  Also fails when NO "
+                        "record carries config.incidents — the "
+                        "incident engine silently off must not look "
+                        "like zero incidents")
+    p.add_argument("--max-slo-burn", action="append", default=[],
+                   metavar="NAME:RATE",
+                   help="fail when a newest record's "
+                        "config.slo_burn_rates[NAME] (worst error-"
+                        "budget burn rate of SLO NAME over the run, "
+                        "1.0 = spending the budget exactly; from "
+                        "scripts/telemetry_summary.py / "
+                        "scripts/incident_smoke.py) exceeds RATE; "
+                        "repeatable.  Also fails when NO record "
+                        "carries the named rate — SLO tracking "
+                        "silently off must not look like a healthy "
+                        "burn rate")
     p.add_argument("--require-tuned", action="store_true",
                    help="fail when a newest record's config lacks "
                         "`tuned: true` — i.e. its knobs did NOT come "
@@ -270,11 +294,16 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
           max_early_exit_epe_delta=None, max_kernel_slowdown=None,
           min_mfu=None, max_flops_per_pair_growth=None,
           max_quality_drift=None, max_canary_proxy_delta=None,
-          min_warm_iters_saved_frac=None, max_stream_epe_delta=None):
+          min_warm_iters_saved_frac=None, max_stream_epe_delta=None,
+          max_incidents=None, max_slo_burn=None):
     """``(failures, report)`` over the newest record of each metric."""
     failures, report = [], []
     cp_gates = dict(max_critical_path_ms or {})
     cp_seen = set()
+    inc_gates = dict(max_incidents or {})
+    inc_seen = set()
+    slo_gates = dict(max_slo_burn or {})
+    slo_seen = set()
     ker_gates = dict(max_kernel_slowdown or {})
     ker_seen = set()
     mfu_gates = dict(min_mfu or {})
@@ -475,6 +504,35 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
                         f"{max_stream_epe_delta:g} — streaming warm "
                         "start costs more accuracy vs independent "
                         "pairs than the budget allows")
+        # Incident-engine gates (docs/OBSERVABILITY.md "Incidents &
+        # SLOs"): a run that paged its way through a cascade still
+        # posts a throughput number — these are the gates that notice.
+        # A record qualifies by carrying config.incidents at all (an
+        # EMPTY dict is a healthy incident-enabled run; the key's
+        # absence means the engine never ran).
+        inc = cfg.get("incidents")
+        if isinstance(inc, dict):
+            for sev, budget in inc_gates.items():
+                inc_seen.add(sev)
+                n = inc.get(sev, 0)
+                if isinstance(n, (int, float)) and n > budget:
+                    failures.append(
+                        f"{metric}: incidents[{sev!r}]={int(n)} > "
+                        f"{budget:g} — the run opened more {sev} "
+                        "incidents than the budget allows "
+                        "(python -m raft_tpu incidents list)")
+        sbr = cfg.get("slo_burn_rates")
+        if isinstance(sbr, dict):
+            for name, budget in slo_gates.items():
+                v = sbr.get(name)
+                if isinstance(v, (int, float)):
+                    slo_seen.add(name)
+                    if v > budget:
+                        failures.append(
+                            f"{metric}: slo_burn_rates[{name!r}]="
+                            f"{v:g} > {budget:g} — the {name} SLO "
+                            "burned its error budget faster than the "
+                            "gate allows")
         sn = cfg.get("serve_span_names")
         if isinstance(sn, list) and sn:
             missing = sorted(set(SERVE_REQUIRED_SPANS) - set(sn))
@@ -554,6 +612,18 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
             "config.stream_epe_delta — the streaming bench "
             "(scripts/bench_stream.py) did not run both arms; the "
             "gate cannot pass vacuously")
+    for sev in sorted(set(inc_gates) - inc_seen):
+        failures.append(
+            f"incident gate {sev!r}: no record carries "
+            "config.incidents — the incident engine "
+            "(ServeConfig.incidents / RAFT_INCIDENTS=1) did not run; "
+            "the gate cannot pass vacuously")
+    for name in sorted(set(slo_gates) - slo_seen):
+        failures.append(
+            f"slo-burn gate {name!r}: no record carries "
+            f"config.slo_burn_rates[{name!r}] — SLO tracking for that "
+            "objective did not run (slo_* targets unset?); the gate "
+            "cannot pass vacuously")
     if max_canary_proxy_delta is not None and not cpx_seen:
         failures.append(
             "canary-proxy gate: no record carries "
@@ -820,6 +890,41 @@ def _selftest() -> int:
         ("high stream EPE delta without the gate passes",
          run([30.0, 31.0, 30.5],
              last_cfg={"stream_epe_delta": 9.0}), False),
+        ("incidents within budget pass",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"incidents": {"critical": 1}},
+             max_incidents={"critical": 1}), False),
+        ("incidents over budget fail",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"incidents": {"critical": 2, "warning": 1}},
+             max_incidents={"critical": 1}), True),
+        ("empty incidents dict satisfies a zero budget",
+         run([30.0, 31.0, 30.5], last_cfg={"incidents": {}},
+             max_incidents={"critical": 0}), False),
+        ("incident gate without data fails",
+         run([30.0, 31.0, 30.5], max_incidents={"critical": 0}), True),
+        ("incidents without the gate pass",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"incidents": {"critical": 9}}), False),
+        ("slo burn within budget passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"slo_burn_rates": {"availability": 0.4}},
+             max_slo_burn={"availability": 1.0}), False),
+        ("slo burn over budget fails",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"slo_burn_rates": {"availability": 14.4}},
+             max_slo_burn={"availability": 1.0}), True),
+        ("slo-burn gate without the named series fails",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"slo_burn_rates": {"latency": 0.0}},
+             max_slo_burn={"availability": 1.0}), True),
+        ("slo-burn gate without data fails",
+         run([30.0, 31.0, 30.5], max_slo_burn={"availability": 1.0}),
+         True),
+        ("hot slo burn without the gate passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"slo_burn_rates": {"availability": 99.0}}),
+         False),
     ]
 
     def run_lint(payload):
@@ -897,7 +1002,13 @@ def main(argv=None):
                              min_warm_iters_saved_frac=(
                                  args.min_warm_iters_saved_frac),
                              max_stream_epe_delta=(
-                                 args.max_stream_epe_delta))
+                                 args.max_stream_epe_delta),
+                             max_incidents=parse_named_gates(
+                                 args.max_incidents, "--max-incidents",
+                                 ("N", "critical:0")),
+                             max_slo_burn=parse_named_gates(
+                                 args.max_slo_burn, "--max-slo-burn",
+                                 ("RATE", "availability:1")))
     if args.lint_report:
         failures.extend(lint_gate(args.lint_report))
     print(json.dumps({"ok": not failures, "failures": failures,

@@ -86,12 +86,20 @@ def last_run(records):
     ``retires`` collects ``serve_retire`` iteration counts over the
     whole log, split by the event's ``warm`` tag (streaming warm-start
     frames vs cold admissions, docs/SERVING.md "Streaming sessions") —
-    the split is what makes the warm saving visible in a summary."""
+    the split is what makes the warm saving visible in a summary.
+
+    ``incidents`` collects the incident-engine stream
+    (``incident_open``/``incident_close``/``slo_burn`` events plus the
+    final SLO gauge values, docs/OBSERVABILITY.md "Incidents & SLOs")
+    over the whole log like ``faults`` — an incident that opened before
+    the last restart still happened."""
     run_cfg, steps, health, spans, costs = None, [], [], [], []
     faults = {"sample_quarantine": 0, "ckpt_fallback": 0,
               "serve_retry": 0, "chaos_inject": 0}
     quality = {"scores": [], "drifts": []}
     retires = {"warm": [], "cold": []}
+    incidents = {"opened": [], "closed": 0, "burns": [],
+                 "burn_gauge": {}, "budget_gauge": {}}
     for rec in records:
         ev = rec.get("event")
         if ev == "run_config":
@@ -113,6 +121,12 @@ def last_run(records):
             if isinstance(it, (int, float)):
                 retires["warm" if rec.get("warm")
                         else "cold"].append(int(it))
+        elif ev == "incident_open":
+            incidents["opened"].append(rec)
+        elif ev == "incident_close":
+            incidents["closed"] += 1
+        elif ev == "slo_burn":
+            incidents["burns"].append(rec)
         elif ev == "metrics_summary":
             # The run's final raft_cost_mfu gauge values ride along as
             # a synthetic record so summarize() folds them next to the
@@ -121,10 +135,21 @@ def last_run(records):
                                               {}).get("values")
             if vals:
                 costs.append({"_mfu_gauge": vals})
+            # Final SLO gauge values (same pattern): a healthy tracked
+            # run summarizes with explicit 0.0 burn rates, so the
+            # check_regression --max-slo-burn gate has a record to read
+            # even when no slo_burn event ever fired.
+            for gauge, key in (("raft_slo_burn_rate", "burn_gauge"),
+                               ("raft_slo_budget_remaining",
+                                "budget_gauge")):
+                vals = rec.get("metrics", {}).get(gauge, {}).get(
+                    "values")
+                if vals:
+                    incidents[key] = vals
         elif ev in faults:
             faults[ev] += 1
     return (run_cfg, steps, health, faults, spans, costs, quality,
-            retires)
+            retires, incidents)
 
 
 def _wait_s(rec):
@@ -263,8 +288,64 @@ def retire_summary(retires):
     return out
 
 
+def _slo_label(label):
+    """``"slo=avail"`` (registry snapshot label string) -> ``"avail"``."""
+    for part in str(label).split(","):
+        if part.startswith("slo="):
+            return part[len("slo="):]
+    return str(label)
+
+
+def incident_summary(incidents):
+    """Fold the incident-engine stream (``incident_open`` /
+    ``incident_close`` / ``slo_burn`` events + final SLO gauge values,
+    raft_tpu/obs/incident.py + obs/slo.py) into config-block fields:
+    incident counts by peak severity, how many never closed, and the
+    worst per-SLO burn rate / budget remaining — merged from burn
+    events AND the final gauges, so a healthy tracked run reports an
+    explicit 0.0 instead of omitting the field
+    (``check_regression.py --max-incidents / --max-slo-burn`` gate on
+    these).  Returns ``{}`` for logs without incident telemetry — old
+    logs summarize unchanged."""
+    if not incidents or not (incidents.get("opened")
+                             or incidents.get("burns")
+                             or incidents.get("burn_gauge")):
+        return {}
+    out = {}
+    opened = incidents.get("opened", [])
+    if opened:
+        by_sev = {}
+        for rec in opened:
+            sev = rec.get("severity", "warning")
+            by_sev[sev] = by_sev.get(sev, 0) + 1
+        out["incidents"] = dict(sorted(by_sev.items()))
+        out["incidents_total"] = len(opened)
+        out["incidents_open"] = max(
+            len(opened) - incidents.get("closed", 0), 0)
+    rates = {_slo_label(k): float(v)
+             for k, v in incidents.get("burn_gauge", {}).items()}
+    budgets = {_slo_label(k): float(v)
+               for k, v in incidents.get("budget_gauge", {}).items()}
+    for rec in incidents.get("burns", []):
+        name = rec.get("slo", "?")
+        rate = rec.get("burn_rate")
+        if isinstance(rate, (int, float)):
+            rates[name] = max(rates.get(name, 0.0), float(rate))
+        rem = rec.get("budget_remaining")
+        if isinstance(rem, (int, float)):
+            budgets[name] = min(budgets.get(name, 1.0), float(rem))
+    if rates:
+        out["slo_burn_rates"] = {k: round(v, 4)
+                                 for k, v in sorted(rates.items())}
+    if budgets:
+        out["slo_budget_remaining"] = {
+            k: round(v, 4) for k, v in sorted(budgets.items())}
+    return out
+
+
 def summarize(run_cfg, steps, health=None, faults=None, spans=None,
-              costs=None, quality=None, retires=None, skip=2):
+              costs=None, quality=None, retires=None, incidents=None,
+              skip=2):
     if run_cfg is None:
         raise SystemExit("no run_config event in log (telemetry written "
                          "by an older build?) — cannot recover batch "
@@ -320,6 +401,9 @@ def summarize(run_cfg, steps, health=None, faults=None, spans=None,
     health_cfg.update(quality_summary(quality))
     # Streaming warm/cold retirement fold (docs/SERVING.md).
     health_cfg.update(retire_summary(retires))
+    # Incident + SLO-burn fold (docs/OBSERVABILITY.md "Incidents &
+    # SLOs").
+    health_cfg.update(incident_summary(incidents))
     last_health = (health or [None])[-1]
     if last_health is not None:
         health_cfg["nonfinite_steps_total"] = last_health.get(
@@ -355,10 +439,10 @@ def summarize(run_cfg, steps, health=None, faults=None, spans=None,
 def main(argv=None):
     args = parse_args(argv)
     (run_cfg, steps, health, faults, spans, costs, quality,
-     retires) = last_run(iter_records(args.path))
+     retires, incidents) = last_run(iter_records(args.path))
     print(json.dumps(summarize(run_cfg, steps, health, faults, spans,
                                costs, skip=args.skip, quality=quality,
-                               retires=retires)))
+                               retires=retires, incidents=incidents)))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,594 @@
+"""Incident-engine tests (tier-1): burn-rate math at window and
+threshold edges, budget exhaustion/recovery, incident open/fold/dedup,
+post-close cooldown rate-limiting, forensic-bundle completeness, the
+flight-recorder ring bound, size-capped EventSink rotation (reader
+contract preserved), the ``incidents`` CLI, and the end-to-end chaos
+drill (``scripts/incident_smoke.py --tiny``).
+
+Everything but the drill runs on synthetic records with an injectable
+clock — no model, no device work, milliseconds per test."""
+
+import glob
+import importlib.util
+import json
+import os.path as osp
+
+import pytest
+
+from raft_tpu.obs.events import EventSink
+from raft_tpu.obs.incident import FlightRecorder, IncidentManager
+from raft_tpu.obs.registry import MetricRegistry
+from raft_tpu.obs.slo import (BurnWindow, SLOSpec, SLOTracker,
+                              scaled_policy)
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, osp.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# SLO specs + burn-rate math
+# ---------------------------------------------------------------------------
+
+
+WINDOW = BurnWindow(100.0, 10.0, 2.0, "page")
+
+
+def _tracker(objective=0.9, **kw):
+    clock = FakeClock()
+    spec = SLOSpec("avail", objective, windows=(WINDOW,))
+    kw.setdefault("check_interval_s", 1e9)  # explicit check() only
+    return SLOTracker([spec], clock=clock, **kw), clock
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        SLOSpec("x", 1.0)       # zero error budget
+    with pytest.raises(ValueError):
+        SLOSpec("x", 0.0)
+    with pytest.raises(ValueError):
+        BurnWindow(10.0, 20.0, 1.0)   # short > long
+    with pytest.raises(ValueError):
+        BurnWindow(10.0, 5.0, 0.0)    # zero threshold
+    with pytest.raises(ValueError):
+        BurnWindow(10.0, 5.0, 1.0, severity="sev1")
+    assert SLOSpec("x", 0.99).budget == pytest.approx(0.01)
+
+
+def test_scaled_policy_preserves_ratios():
+    pol = scaled_policy(30.0)
+    assert pol[0].long_s == pytest.approx(30.0)
+    assert pol[0].short_s == pytest.approx(2.5)
+    assert pol[0].threshold == 14.4 and pol[0].severity == "page"
+    assert pol[1].long_s == pytest.approx(180.0)
+    assert pol[1].severity == "ticket"
+
+
+def test_burn_fires_when_both_windows_exceed():
+    # budget 0.1; 2 bad in 10 obs -> bad_frac 0.2 -> burn rate 2.0,
+    # exactly at threshold, in BOTH windows (all obs are recent).
+    tr, clock = _tracker()
+    for _ in range(8):
+        tr.record("avail", True)
+    for _ in range(2):
+        tr.record("avail", False)
+    fired = tr.check()
+    assert len(fired) == 1
+    rec = fired[0]
+    assert rec["slo"] == "avail" and rec["severity"] == "page"
+    assert rec["burn_rate"] == pytest.approx(2.0)
+    assert rec["short_burn_rate"] == pytest.approx(2.0)
+
+
+def test_no_fire_below_threshold():
+    # 1 bad in 10 -> burn rate 1.0 < 2.0 threshold.
+    tr, clock = _tracker()
+    for _ in range(9):
+        tr.record("avail", True)
+    tr.record("avail", False)
+    assert tr.check() == []
+
+
+def test_short_window_gates_reset():
+    # An old burst keeps the LONG window hot, but once the short
+    # window is clean the alert must not fire (reset-lag gate).
+    tr, clock = _tracker()
+    for _ in range(5):
+        tr.record("avail", False)
+    for _ in range(5):
+        tr.record("avail", True)
+    clock.advance(95.0)             # burst leaves the short window
+    for _ in range(10):
+        tr.record("avail", True)
+    # long window: 5 bad / 20 -> burn 2.5 >= 2; short: 0.0 -> gated.
+    assert tr.check() == []
+
+
+def test_window_edge_prunes_old_observations():
+    tr, clock = _tracker()
+    for _ in range(10):
+        tr.record("avail", False)
+    clock.advance(101.0)            # everything ages out of max window
+    assert tr.check() == []         # no data -> no alert
+    snap = tr.snapshot()["avail"]
+    assert snap["burn_rate"] == 0.0
+    assert snap["budget_remaining"] == 1.0
+
+
+def test_cooldown_then_refire():
+    tr, clock = _tracker()
+    for _ in range(10):
+        tr.record("avail", False)
+    assert len(tr.check()) == 1
+    assert tr.check() == []         # within cooldown (= short_s)
+    clock.advance(WINDOW.short_s + 0.1)
+    for _ in range(10):
+        tr.record("avail", False)   # still burning
+    assert len(tr.check()) == 1     # re-fires after cooldown
+
+
+def test_budget_exhaustion_and_recovery():
+    tr, clock = _tracker()
+    for _ in range(10):
+        tr.record("avail", False)   # bad_frac 1.0 >= budget
+    assert tr.snapshot()["avail"]["budget_remaining"] == 0.0
+    clock.advance(101.0)
+    for _ in range(10):
+        tr.record("avail", True)
+    snap = tr.snapshot()["avail"]
+    assert snap["budget_remaining"] == 1.0
+    assert snap["good"] == 10 and snap["bad"] == 10  # lifetime counts
+
+
+def test_unknown_name_ignored_and_duplicate_rejected():
+    tr, _ = _tracker()
+    tr.record("nope", False)        # silently ignored
+    assert "nope" not in tr.snapshot()
+    with pytest.raises(ValueError):
+        SLOTracker([SLOSpec("a", 0.9), SLOSpec("a", 0.9)])
+
+
+def test_slo_burn_event_and_gauges(tmp_path):
+    reg = MetricRegistry()
+    clock = FakeClock()
+    sink = EventSink(str(tmp_path))
+    tr = SLOTracker([SLOSpec("avail", 0.9, windows=(WINDOW,))],
+                    registry=reg, sink=sink, check_interval_s=1e9,
+                    clock=clock)
+    for _ in range(10):
+        tr.record("avail", False)
+    assert len(tr.check()) == 1
+    sink.close()
+    recs = [json.loads(l) for l in open(sink.path)]
+    burns = [r for r in recs if r["event"] == "slo_burn"]
+    assert len(burns) == 1
+    assert burns[0]["slo"] == "avail"
+    assert burns[0]["budget_remaining"] == 0.0
+    snap = reg.snapshot()           # runs the collect hook
+    assert snap["raft_slo_burn_rate"]["values"]["slo=avail"] >= 2.0
+    assert snap["raft_slo_budget_remaining"]["values"]["slo=avail"] \
+        == 0.0
+    assert snap["raft_slo_burns_total"]["values"][
+        "severity=page,slo=avail"] == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_ring_is_bounded():
+    fr = FlightRecorder(capacity=64)
+    for i in range(1000):
+        fr.observe({"event": "x", "i": i, "t_mono": float(i)})
+    assert len(fr) == 64
+    recs = fr.recent()
+    assert recs[0]["i"] == 1000 - 64 and recs[-1]["i"] == 999
+    # window filter keys off t_mono
+    assert len(fr.recent(window_s=10.0, now=999.0)) == 11
+
+
+def test_recorder_provider_errors_degrade():
+    fr = FlightRecorder()
+    fr.add_provider("ok", lambda: {"a": 1})
+    fr.add_provider("boom", lambda: 1 / 0)
+    snaps = fr.snapshots()
+    assert snaps["ok"] == {"a": 1}
+    assert "ZeroDivisionError" in snaps["boom"]
+
+
+# ---------------------------------------------------------------------------
+# incident manager: open / fold / dedup / cooldown / bundle
+# ---------------------------------------------------------------------------
+
+
+def _rec(event, t, **fields):
+    return dict({"event": event, "t_wall": 1e9 + t, "t_mono": t},
+                **fields)
+
+
+def _manager(tmp_path, clock, **kw):
+    kw.setdefault("window_s", 10.0)
+    kw.setdefault("quiet_close_s", 5.0)
+    kw.setdefault("cooldown_s", 60.0)
+    return IncidentManager(directory=str(tmp_path / "incidents"),
+                           registry=kw.pop("registry", None),
+                           clock=clock, **kw)
+
+
+def test_cascade_folds_into_one_incident(tmp_path):
+    clock = FakeClock(t=100.0)
+    reg = MetricRegistry()
+    mgr = _manager(tmp_path, clock, registry=reg)
+    mgr.observe(_rec("chaos_inject", 95.0))      # info: never opens...
+    assert mgr.snapshot()["open"] is None
+    mgr.observe(_rec("replica_crash", 100.0))    # ...opens here
+    clock.advance(1.0)
+    mgr.observe(_rec("serve_retry", 101.0))      # folds (new signal)
+    mgr.observe(_rec("serve_retry", 101.2))      # folds (dedup: count)
+    mgr.observe(_rec("fleet_restart", 101.5))
+    snap = mgr.snapshot()
+    assert mgr.opened == 1 and snap["open"] is not None
+    # first-fired order: the info-severity chaos_inject seeded from the
+    # ring window leads (probable cause), crash escalated the severity
+    assert snap["open"]["signals"] == [
+        "chaos_inject", "replica_crash", "serve_retry", "fleet_restart"]
+    assert snap["open"]["severity"] == "critical"
+    clock.advance(6.0)                           # > quiet_close_s
+    mgr.poll()
+    assert mgr.snapshot()["open"] is None
+    bundles = sorted((tmp_path / "incidents").iterdir())
+    assert len(bundles) == 1
+    inc = json.loads((bundles[0] / "incident.json").read_text())
+    assert inc["status"] == "closed" and inc["close_reason"] == "quiet"
+    sigs = {s["event"]: s for s in inc["signals"]}
+    assert sigs["serve_retry"]["count"] == 2     # deduped, counted
+    vals = reg.snapshot()
+    assert vals["raft_incidents_total"]["values"][
+        "severity=critical"] == 1
+    assert vals["raft_incidents_open"]["values"][""] == 0
+
+
+def test_info_severity_never_opens(tmp_path):
+    clock = FakeClock()
+    mgr = _manager(tmp_path, clock)
+    for i in range(5):
+        mgr.observe(_rec("chaos_inject", clock.t + i * 0.1))
+    assert mgr.opened == 0
+    assert not (tmp_path / "incidents").exists()
+
+
+def test_non_anomaly_events_never_open(tmp_path):
+    clock = FakeClock()
+    mgr = _manager(tmp_path, clock)
+    mgr.observe(_rec("train_step", clock.t))
+    mgr.observe(_rec("cost_report", clock.t))
+    assert mgr.opened == 0 and len(mgr.recorder) == 2
+
+
+def test_cooldown_rate_limits_flapping(tmp_path):
+    clock = FakeClock(t=100.0)
+    reg = MetricRegistry()
+    mgr = _manager(tmp_path, clock, registry=reg, cooldown_s=30.0)
+    mgr.observe(_rec("stall", 100.0))
+    clock.advance(6.0)
+    mgr.poll()                                   # quiet close
+    assert mgr.opened == 1
+    clock.advance(1.0)
+    mgr.observe(_rec("stall", clock.t))          # inside cooldown
+    assert mgr.opened == 1 and mgr.suppressed == 1
+    clock.advance(31.0)
+    mgr.observe(_rec("stall", clock.t))          # cooldown expired
+    assert mgr.opened == 2
+    assert reg.snapshot()["raft_incidents_suppressed_total"][
+        "values"][""] == 1
+
+
+def test_close_finalizes_open_incident(tmp_path):
+    clock = FakeClock()
+    mgr = _manager(tmp_path, clock)
+    mgr.observe(_rec("nonfinite_step", clock.t))
+    mgr.close()
+    bundles = list((tmp_path / "incidents").iterdir())
+    inc = json.loads((bundles[0] / "incident.json").read_text())
+    assert inc["close_reason"] == "finalized"
+
+
+def test_bundle_completeness(tmp_path):
+    clock = FakeClock(t=50.0)
+    reg = MetricRegistry()
+    mgr = _manager(tmp_path, clock, registry=reg)
+    mgr.recorder.add_provider("engine_stats", lambda: {"ready": True})
+    mgr.observe(_rec("trace_span", 48.0, name="route"))
+    mgr.observe(_rec("serve_retry_deadline", 50.0))
+    clock.advance(6.0)
+    mgr.poll()
+    bdir = next((tmp_path / "incidents").iterdir())
+    names = {p.name for p in bdir.iterdir()}
+    assert names == {"incident.json", "events.jsonl", "traces.jsonl",
+                     "metrics.json", "stats.json"}
+    window = [json.loads(l)
+              for l in (bdir / "events.jsonl").read_text().splitlines()]
+    assert {"trace_span", "serve_retry_deadline"} <= \
+        {r["event"] for r in window}
+    spans = [json.loads(l)
+             for l in (bdir / "traces.jsonl").read_text().splitlines()]
+    assert len(spans) == 1 and spans[0]["name"] == "route"
+    stats = json.loads((bdir / "stats.json").read_text())
+    assert stats["engine_stats"] == {"ready": True}
+    assert "raft_incidents_total" in json.loads(
+        (bdir / "metrics.json").read_text())
+
+
+def test_manager_rides_sink_observer_and_reemits(tmp_path):
+    """attach() wires the manager into a live sink; incident_* records
+    flow back through the SAME sink without deadlock or re-trigger."""
+    sink = EventSink(str(tmp_path))
+    mgr = IncidentManager(window_s=10.0, quiet_close_s=5.0)
+    mgr.attach(sink)
+    sink.emit("serve_ready")                     # not an anomaly
+    sink.emit("replica_crash", reason="test")
+    mgr.close()
+    sink.close()
+    recs = [json.loads(l) for l in open(sink.path)]
+    kinds = [r["event"] for r in recs]
+    assert "incident_open" in kinds and "incident_close" in kinds
+    assert mgr.opened == 1                       # incident_* not triggers
+    opened = next(r for r in recs if r["event"] == "incident_open")
+    assert opened["signals"] == ["replica_crash"]
+    # attach() adopted the sink's directory for bundles
+    assert (tmp_path / "incidents").is_dir()
+
+
+def test_slo_burn_page_opens_incident(tmp_path):
+    clock = FakeClock()
+    mgr = _manager(tmp_path, clock, open_severity="critical")
+    mgr.observe(_rec("slo_burn", clock.t, slo="avail", severity="page"))
+    assert mgr.opened == 1
+    mgr2 = _manager(tmp_path / "2", clock, open_severity="critical")
+    mgr2.observe(_rec("slo_burn", clock.t, slo="avail",
+                      severity="ticket"))        # warning < critical
+    assert mgr2.opened == 0
+
+
+# ---------------------------------------------------------------------------
+# EventSink size-capped rotation (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_rotation_bounds_disk_and_keeps_reader_contract(tmp_path):
+    sink = EventSink(str(tmp_path), max_bytes=64 * 1024)
+    n = 3000                        # ~100 bytes/record -> ~300 KiB
+    for i in range(n):
+        sink.emit("tick", seq=i, pad="x" * 40)
+    sink.close()
+    files = sorted(glob.glob(str(tmp_path / "*.jsonl")))
+    assert 2 <= len(files) <= 4     # live + <= 3 rotated
+    total = sum(osp.getsize(f) for f in files)
+    assert total <= 64 * 1024 + 8 * 1024
+    # Reader contract: the sorted *.jsonl glob (telemetry_summary.py's
+    # iter_records) yields surviving records in chronological order.
+    seqs = []
+    for f in files:
+        for line in open(f):
+            seqs.append(json.loads(line)["seq"])
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == n - 1        # newest records always survive
+    # rotated names sort BEFORE the live file ('-' < '.')
+    assert all("-r" in f for f in files[:-1])
+    assert files[-1].endswith(f"telemetry-p0.jsonl")
+
+
+def test_rotation_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TELEMETRY_MAX_MB", "0.0625")  # 64 KiB
+    sink = EventSink(str(tmp_path))
+    assert sink._max_bytes == 64 * 1024
+    monkeypatch.setenv("RAFT_TELEMETRY_MAX_MB", "garbage")
+    assert EventSink(str(tmp_path))._max_bytes is None
+    monkeypatch.delenv("RAFT_TELEMETRY_MAX_MB")
+    assert EventSink(str(tmp_path))._max_bytes is None
+    sink.close()
+
+
+def test_rotation_off_by_default(tmp_path):
+    sink = EventSink(str(tmp_path))
+    for i in range(200):
+        sink.emit("tick", seq=i)
+    sink.close()
+    assert glob.glob(str(tmp_path / "*-r*.jsonl")) == []
+
+
+def test_rotation_sequence_survives_reopen(tmp_path):
+    sink = EventSink(str(tmp_path), max_bytes=16 * 1024)
+    for i in range(600):
+        sink.emit("tick", seq=i, pad="x" * 40)
+    sink.close()
+    sink2 = EventSink(str(tmp_path), max_bytes=16 * 1024)
+    for i in range(600, 1200):
+        sink2.emit("tick", seq=i, pad="x" * 40)
+    sink2.close()
+    files = sorted(glob.glob(str(tmp_path / "*.jsonl")))
+    seqs = []
+    for f in files:
+        for line in open(f):
+            seqs.append(json.loads(line)["seq"])
+    assert seqs == sorted(seqs)     # numbering continued, no collision
+
+
+# ---------------------------------------------------------------------------
+# telemetry_summary fold (satellite: digest + gate producers)
+# ---------------------------------------------------------------------------
+
+
+def _write_telemetry_log(tmp_path, extra_records):
+    recs = [{"event": "run_config", "batch_size": 2, "num_devices": 1,
+             "image_size": [32, 32]}]
+    recs += [{"event": "train_step", "step": i, "step_time_s": 0.1}
+             for i in range(3)]
+    recs += extra_records
+    (tmp_path / "telemetry-p0.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in recs))
+
+
+def test_telemetry_summary_folds_incidents(tmp_path):
+    ts = _load_script("telemetry_summary")
+    _write_telemetry_log(tmp_path, [
+        {"event": "incident_open", "severity": "critical"},
+        {"event": "incident_open", "severity": "warning"},
+        {"event": "incident_close"},
+        {"event": "slo_burn", "slo": "availability", "burn_rate": 14.4,
+         "budget_remaining": 0.8, "severity": "page"},
+        {"event": "metrics_summary", "metrics": {
+            "raft_slo_burn_rate": {"values": {"slo=latency": 0.0}},
+            "raft_slo_budget_remaining": {"values": {"slo=latency": 1.0}},
+        }},
+    ])
+    out = ts.summarize(*ts.last_run(ts.iter_records(str(tmp_path))),
+                       skip=0)
+    cfg = out["config"]
+    assert cfg["incidents"] == {"critical": 1, "warning": 1}
+    assert cfg["incidents_total"] == 2
+    assert cfg["incidents_open"] == 1      # two opened, one closed
+    # Burn events and final gauges merge (worst rate, least budget);
+    # the quiet latency SLO reports an explicit 0.0, not an omission.
+    assert cfg["slo_burn_rates"] == {"availability": 14.4,
+                                     "latency": 0.0}
+    assert cfg["slo_budget_remaining"] == {"availability": 0.8,
+                                           "latency": 1.0}
+
+
+def test_telemetry_summary_healthy_run_reports_zero_burn(tmp_path):
+    ts = _load_script("telemetry_summary")
+    _write_telemetry_log(tmp_path, [
+        {"event": "metrics_summary", "metrics": {
+            "raft_slo_burn_rate": {"values": {"slo=availability": 0.0}},
+            "raft_slo_budget_remaining": {
+                "values": {"slo=availability": 1.0}},
+        }},
+    ])
+    out = ts.summarize(*ts.last_run(ts.iter_records(str(tmp_path))),
+                       skip=0)
+    cfg = out["config"]
+    # No incidents opened -> no incident count fields, but the gauge
+    # keeps the --max-slo-burn gate fed with an explicit healthy 0.0.
+    assert "incidents" not in cfg
+    assert cfg["slo_burn_rates"] == {"availability": 0.0}
+    assert cfg["slo_budget_remaining"] == {"availability": 1.0}
+
+
+def test_telemetry_summary_plain_log_unchanged(tmp_path):
+    ts = _load_script("telemetry_summary")
+    _write_telemetry_log(tmp_path, [])
+    out = ts.summarize(*ts.last_run(ts.iter_records(str(tmp_path))),
+                       skip=0)
+    for key in ("incidents", "incidents_total", "slo_burn_rates",
+                "slo_budget_remaining"):
+        assert key not in out["config"]
+
+
+# ---------------------------------------------------------------------------
+# the incidents CLI
+# ---------------------------------------------------------------------------
+
+
+def _fake_bundle(root, inc_id, t0=1000.0, signals=()):
+    bdir = root / "incidents" / inc_id
+    bdir.mkdir(parents=True)
+    inc = {"id": inc_id, "status": "closed", "severity": "critical",
+           "opened_t_wall": t0, "opened_t_mono": t0,
+           "closed_t_wall": t0 + 3.0, "close_reason": "quiet",
+           "duration_s": 3.0, "trigger": "replica_crash",
+           "events": len(signals),
+           "signals": [{"event": e, "severity": "warning",
+                        "first_t_wall": t0 + dt, "first_t_mono": t0 + dt,
+                        "last_t_wall": t0 + dt, "count": 1}
+                       for e, dt in signals]}
+    (bdir / "incident.json").write_text(json.dumps(inc))
+    (bdir / "events.jsonl").write_text(json.dumps(
+        {"event": "replica_crash", "t_wall": t0}) + "\n")
+    return inc
+
+
+def test_cli_list_show_timeline(tmp_path, capsys):
+    from raft_tpu.cli import incidents as cli
+
+    _fake_bundle(tmp_path, "inc-a-001",
+                 signals=[("serve_retry", 1.0), ("chaos_inject", 0.0)])
+    _fake_bundle(tmp_path, "inc-b-002", t0=2000.0,
+                 signals=[("stall", 0.0)])
+    assert cli.main(["list", "--json",
+                     "--telemetry-dir", str(tmp_path)]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert [r["id"] for r in rows] == ["inc-a-001", "inc-b-002"]
+    assert cli.main(["show", "inc-a", "--json",
+                     "--telemetry-dir", str(tmp_path)]) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown["id"] == "inc-a-001"
+    assert shown["bundle"]["events.jsonl"]["records"] == 1
+    # timeline: first-fired (probable-cause) ordering, NOT file order
+    assert cli.main(["timeline", "inc-a", "--json",
+                     "--telemetry-dir", str(tmp_path)]) == 0
+    tl = json.loads(capsys.readouterr().out)
+    assert tl["probable_cause"] == "chaos_inject"
+    assert [s["event"] for s in tl["signals"]] == \
+        ["chaos_inject", "serve_retry"]
+    # human layouts render without error
+    for action in ("list", "show", "timeline"):
+        assert cli.main([action, "inc-b",
+                         "--telemetry-dir", str(tmp_path)]) == 0
+        assert "inc-b-002" in capsys.readouterr().out
+
+
+def test_cli_errors(tmp_path, capsys):
+    from raft_tpu.cli import incidents as cli
+
+    assert cli.main(["list", "--telemetry-dir",
+                     str(tmp_path / "nope")]) == 0   # empty, not fatal
+    capsys.readouterr()
+    assert cli.main(["show", "--telemetry-dir", str(tmp_path)]) == 2
+    _fake_bundle(tmp_path, "inc-a-001")
+    _fake_bundle(tmp_path, "inc-a-002")
+    with pytest.raises(SystemExit):                  # ambiguous prefix
+        cli.main(["show", "inc-a", "--telemetry-dir", str(tmp_path)])
+    with pytest.raises(SystemExit):                  # no match
+        cli.main(["show", "zzz", "--telemetry-dir", str(tmp_path)])
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end drill
+# ---------------------------------------------------------------------------
+
+
+def test_incident_smoke_tiny(capsys):
+    """The chaos drill the PR promises: quiet baseline opens nothing
+    and stays compile-pinned; a kill + device-error cascade correlates
+    into exactly ONE incident with a complete forensic bundle."""
+    mod = _load_script("incident_smoke")
+    rc = mod.main(["--tiny", "--requests", "10"])
+    out = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(out[-1])
+    assert rc == 0
+    assert rec["metric"] == "incident_smoke" and rec["value"] == 1.0
+    cascade = rec["config"]["cascade"]
+    assert "serve_retry" in cascade["signals"]
+    assert {"replica_crash", "fleet_restart"} & set(cascade["signals"])
+    assert cascade["trace_spans"] >= 1
+    assert rec["config"]["quiet_baseline"]["incidents"] == 0
