@@ -90,10 +90,22 @@ _RUNTIME_ERROR_TYPES = ("XlaRuntimeError", "JaxRuntimeError",
 
 def is_transient_error(exc: BaseException) -> bool:
     """True when ``exc`` looks like a transient device/transport error
-    worth exactly one retry; False for anything deterministic."""
+    worth exactly one retry; False for anything deterministic.
+
+    Stdlib network taxonomy (multi-host serving, docs/SERVING.md
+    "Multi-host fabric"): a bare ``TimeoutError`` (``socket.timeout``
+    IS ``TimeoutError``) is a deadline flake worth one same-path retry;
+    ``ConnectionError`` (refused / reset — and
+    ``http.client.RemoteDisconnected``, which subclasses reset) indicts
+    the HOST, so retrying the same path cannot help — it is a failover
+    signal instead (:func:`raft_tpu.serve.router.is_failover_error`)."""
     flagged = getattr(exc, "transient", None)
     if flagged is not None:
         return bool(flagged)
+    if isinstance(exc, TimeoutError):
+        return True
+    if isinstance(exc, ConnectionError):
+        return False
     if type(exc).__name__ not in _RUNTIME_ERROR_TYPES:
         return False
     msg = str(exc)

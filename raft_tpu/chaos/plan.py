@@ -27,7 +27,11 @@ keys (conditions AND together within one rule):
   plan seed, so a given (spec, seed, check order) always fires the same
   checks — chaos runs replay.
 - ``times=K``: stop after K fires (default 1 for deterministic
-  triggers, unlimited for pure ``p=`` rules).
+  triggers, unlimited for pure ``p=`` and windowed ``heal=`` rules).
+- ``heal=M``: with ``step=N``, fire on every check whose step/ordinal
+  falls in ``[N, M)`` and STOP at M — a windowed outage that heals,
+  e.g. ``net_partition@step=0,heal=40`` partitions a remote replica
+  for its first 40 wire operations and then lets it rejoin.
 
 Fault kinds and their seams (the point names appear in the
 ``chaos_inject`` event):
@@ -58,6 +62,17 @@ fault               seam (point)                 injected error
                                                  143 at the boundary)
 ``stage_kill``      ``curriculum.stage_boundary``  ``SystemExit(143)``
                                                  before stage index N
+``net_refuse``      ``serve.remote``             ``RemoteRefusedError``
+                                                 (connect refused)
+``net_slow``        ``serve.remote``             request delayed
+                                                 ``chaos_slow_s``
+``net_drop``        ``serve.remote``             ``RemoteDisconnectedError``
+                                                 (request sent, response
+                                                 never arrives)
+``net_partition``   ``serve.remote``             ``RemoteTimeoutError``
+                                                 on every wire op until
+                                                 the rule's ``heal=``
+                                                 ordinal
 ==================  ===========================  =======================
 
 ``preempt@step=N`` models a SIGTERM landing mid-stage: the train loop
@@ -110,6 +125,7 @@ class Rule:
     step: Optional[int] = None
     p: Optional[float] = None
     times: int = 1          # -1 = unlimited
+    heal: Optional[int] = None  # fire window [step, heal), then stop
     seen: int = 0
     fired: int = 0
     _rng: Optional[np.random.Generator] = None
@@ -123,7 +139,8 @@ class Rule:
         hit = True
         if self.step is not None:
             ref = ctx_step if ctx_step is not None else ordinal
-            hit = ref == self.step
+            hit = (self.step <= ref < self.heal
+                   if self.heal is not None else ref == self.step)
         if self.p is not None:
             draw = float(self._rng.random()) if self._rng is not None \
                 else 1.0
@@ -177,10 +194,12 @@ class FaultPlan:
                         kw["p"] = float(val)
                     elif key == "times":
                         kw["times"] = int(val)
+                    elif key == "heal":
+                        kw["heal"] = int(val)
                     else:
                         raise ChaosSpecError(
                             f"rule {part!r}: unknown key {key!r} "
-                            "(step/batch/call, p, times)")
+                            "(step/batch/call, p, times, heal)")
                 except ValueError as e:
                     if isinstance(e, ChaosSpecError):
                         raise
@@ -194,12 +213,23 @@ class FaultPlan:
             if p is not None and not 0.0 < p <= 1.0:
                 raise ChaosSpecError(f"rule {part!r}: p must be in "
                                      f"(0, 1], got {p}")
-            times = kw.get("times", 1 if "step" in kw else -1)
+            heal = kw.get("heal")
+            if heal is not None:
+                if "step" not in kw:
+                    raise ChaosSpecError(
+                        f"rule {part!r}: heal= needs step= (the "
+                        "outage window is [step, heal))")
+                if heal <= kw["step"]:
+                    raise ChaosSpecError(
+                        f"rule {part!r}: heal ({heal}) must be > "
+                        f"step ({kw['step']})")
+            times = kw.get("times",
+                           1 if "step" in kw and heal is None else -1)
             if times == 0 or times < -1:
                 raise ChaosSpecError(f"rule {part!r}: times must be "
                                      ">= 1 (or -1 = unlimited)")
             rules.append(Rule(fault=fault, step=kw.get("step"), p=p,
-                              times=times))
+                              times=times, heal=heal))
         if not rules:
             raise ChaosSpecError(f"empty chaos spec {spec!r}")
         return cls(rules, seed=seed, spec=spec)

@@ -173,6 +173,18 @@ def parse_args(argv=None):
                    help="engine replicas behind a health-gated router "
                         "with failover (docs/SERVING.md fleet section); "
                         "1 = single engine, no fleet layer")
+    p.add_argument("--remote", action="append", default=None,
+                   metavar="HOST:PORT",
+                   help="join a REMOTE serving host to the fleet as a "
+                        "partition-tolerant replica behind the same "
+                        "router (repeatable; docs/SERVING.md "
+                        "'Multi-host fabric').  Implies fleet mode")
+    p.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                   help="elastic autoscaling bounds on LOCAL replicas "
+                        "(e.g. 1:4): grow on sustained queue pressure, "
+                        "shrink gracefully when idle (hysteresis + "
+                        "cooldown; docs/SERVING.md 'Multi-host "
+                        "fabric').  Implies fleet mode")
     p.add_argument("--aot-dir", default=None,
                    help="AOT executable artifact directory: replica 0 "
                         "exports its compiled executables here, every "
@@ -541,7 +553,14 @@ def main(argv=None):
         from raft_tpu.obs import trace
 
         trace.configure(sample_rate=trace_rate, sink=sink)
-    if args.replicas > 1:
+    autoscale = (0, 0)
+    if args.autoscale:
+        lo, sep, hi = args.autoscale.partition(":")
+        if not sep or not lo.isdigit() or not hi.isdigit():
+            raise SystemExit(
+                f"--autoscale {args.autoscale!r}: expected MIN:MAX")
+        autoscale = (int(lo), int(hi))
+    if args.replicas > 1 or args.remote or args.autoscale:
         from raft_tpu.serve import (FleetConfig, FlowRouter,
                                     ReplicaFleet, RouterConfig)
 
@@ -552,14 +571,22 @@ def main(argv=None):
         fleet = ReplicaFleet(
             variables, model_cfg, serve_cfg,
             FleetConfig(replicas=args.replicas, aot_dir=args.aot_dir,
-                        warmup_shapes=warmup),
+                        warmup_shapes=warmup,
+                        remote=tuple(args.remote or ()),
+                        autoscale_min=autoscale[0],
+                        autoscale_max=autoscale[1]),
             sink=sink)
         fleet.start()
         service = FlowRouter(
             fleet,
             RouterConfig(hedge_timeout_s=max(args.hedge_timeout_s, 0.0)),
             sink=sink)
-        extra = f", replicas={args.replicas}, aot_dir={fleet.aot_dir}"
+        extra = (f", replicas={args.replicas}, "
+                 f"aot_dir={fleet.aot_dir}")
+        if args.remote:
+            extra += f", remote={','.join(args.remote)}"
+        if args.autoscale:
+            extra += f", autoscale={autoscale[0]}:{autoscale[1]}"
     else:
         engine = InferenceEngine(variables, model_cfg, serve_cfg,
                                  sink=sink)

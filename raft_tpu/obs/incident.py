@@ -90,6 +90,13 @@ ANOMALY_EVENTS: Dict[str, str] = {
     "serve_failover": "warning",
     "stream_restart": "warning",
     "stream_stash_error": "warning",
+    # multi-host fabric (serve/remote.py, docs/SERVING.md): a request-
+    # path wire failure correlates into the partition's incident; the
+    # heal-side rejoin and autoscaler moves tag it as context.
+    "net_retry": "warning",
+    "fleet_remote_rejoin": "info",
+    "fleet_scale": "info",
+    "fleet_scale_error": "warning",
     # chaos fires are informational: they tag the correlated-signal
     # list (so a drill's bundle says "injected") but never open.
     "chaos_inject": "info",

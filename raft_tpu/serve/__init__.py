@@ -27,6 +27,14 @@ from raft_tpu.serve.fleet import (
     ReplicaFleet,
     WeightUpdateError,
 )
+from raft_tpu.serve.remote import (
+    RemoteConfig,
+    RemoteEngine,
+    RemoteNetworkError,
+    RemoteProtocolError,
+    RemoteReplica,
+    classify_network_error,
+)
 from raft_tpu.serve.router import (
     FlowRouter,
     RouterConfig,
@@ -43,9 +51,15 @@ __all__ = [
     "InferenceEngine",
     "LatencyRecorder",
     "QueueFullError",
+    "RemoteConfig",
+    "RemoteEngine",
+    "RemoteNetworkError",
+    "RemoteProtocolError",
+    "RemoteReplica",
     "Replica",
     "ReplicaFleet",
     "RouterConfig",
+    "classify_network_error",
     "ServeConfig",
     "WeightUpdateError",
     "export_executables",

@@ -630,6 +630,10 @@ class InferenceEngine:
         # and the hot path is byte-identical to before (the same
         # conditional-construction pattern as the quality monitor).
         self._slo = None
+        # Last measured iteration MFU (None until a cost-stamped device
+        # call completes on a known-peak device) — an autoscaler signal
+        # (load_signals), not an SLO.
+        self._last_mfu: Optional[float] = None
         slo_specs = []
         if (cfg.slo_availability_target > 0 or cfg.slo_latency_target_ms
                 > 0 or cfg.slo_quality_bound > 0 or cfg.slo_mfu_floor
@@ -1210,6 +1214,45 @@ class InferenceEngine:
         drift)."""
         return self.registry.render_prometheus()
 
+    def queue_capacity(self) -> int:
+        """This engine's admission-queue capacity — the router's spill
+        math and the fleet autoscaler read it through the replica
+        facade, so heterogeneous fleets (a remote replica with a
+        different ``max_queue``) scale each replica by its OWN
+        capacity, not a shared config's."""
+        return self.cfg.max_queue
+
+    def load_signals(self) -> dict:
+        """Cheap load snapshot for the fleet autoscaler — reads only
+        locks/atomics already maintained on the hot path (no device
+        work, safe at the supervisor's poll cadence).
+
+        Keys: ``pending`` / ``max_queue`` / ``queue_frac`` (admission
+        pressure), ``occupancy`` (slot utilization), ``burn_rate``
+        (worst SLO burn, 0.0 when SLOs are off), ``mfu`` (last measured
+        iteration MFU, None before a known-peak measurement), and
+        ``latency_p95_ms`` over the recent window."""
+        with self._pending_lock:
+            pending = self._pending
+        cap = max(int(self.cfg.max_queue), 1)
+        burn = 0.0
+        if self._slo is not None:
+            for snap in self._slo.snapshot().values():
+                if isinstance(snap, dict):
+                    burn = max(burn, float(snap.get("burn_rate") or 0.0))
+        counters = self._counters.snapshot(
+            max(jax.local_device_count(), 1))
+        lat = self._latency.snapshot()
+        return {
+            "pending": pending,
+            "max_queue": self.cfg.max_queue,
+            "queue_frac": round(pending / cap, 4),
+            "occupancy": float(counters.get("occupancy") or 0.0),
+            "burn_rate": round(burn, 4),
+            "mfu": self._last_mfu,
+            "latency_p95_ms": float(lat.get("p95_ms") or 0.0),
+        }
+
     def quality_drift(self) -> Optional[dict]:
         """Per-proxy drift-detector state (``None`` when quality
         scoring is disabled) — the fleet supervisor polls this to
@@ -1224,6 +1267,7 @@ class InferenceEngine:
         out = self._counters.snapshot(max(jax.local_device_count(), 1))
         with self._pending_lock:
             out["pending"] = self._pending
+        out["max_queue"] = self.cfg.max_queue
         out["latency_ms"] = self._latency.snapshot()
         out["batching"] = self.cfg.batching
         out["iters_used"] = self._iters_used.snapshot()
@@ -1521,6 +1565,7 @@ class InferenceEngine:
         m = total.mfu(seconds)
         if m is not None:
             attrs["mfu"] = round(m, 4)
+            self._last_mfu = attrs["mfu"]
         return attrs
 
     def _get_executable(self, bucket: tuple, batch_size: int):
@@ -2111,6 +2156,8 @@ class InferenceEngine:
         # raft_cost_mfu/raft_cost_hbm_bw_util gauges, no device work.
         iter_attrs = self.cost_book.observe(
             (bucket, self.cfg.slots, "iter"), t_done - t0)
+        if "mfu" in iter_attrs:
+            self._last_mfu = iter_attrs["mfu"]
         if self._slo is not None and "mfu" in iter_attrs:
             # The MFU-floor SLO (only constructed on known peaks):
             # one observation per measured iteration.

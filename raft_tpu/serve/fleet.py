@@ -166,6 +166,35 @@ class FleetConfig:
     #: ``canary_shapes``/``warmup_shapes``; with neither configured the
     #: proxy gate is skipped (scoring an unwarmed shape would compile).
     canary_proxy_budget: Optional[float] = 3.0
+    #: Remote replica specs (``HOST:PORT``) joined behind the same
+    #: router (docs/SERVING.md "Multi-host fabric").  Remote replicas
+    #: are supervised by health transitions only — the remote HOST owns
+    #: its engine lifecycle, weights, and warmup.
+    remote: Tuple[str, ...] = ()
+    #: Wire knobs for the remote replicas — a
+    #: :class:`raft_tpu.serve.remote.RemoteConfig` (left untyped to keep
+    #: the remote module out of the fleet's import graph); ``None``
+    #: uses its defaults.
+    remote_cfg: Optional[object] = None
+    #: Elastic autoscaling of LOCAL replicas (``autoscale_max = 0``
+    #: disables it; otherwise ``autoscale_min <= replicas <=
+    #: autoscale_max`` must hold).  Signals, hysteresis, and cooldown:
+    #: pressure must persist ``autoscale_up_consecutive`` autoscaler
+    #: ticks before a grow (fleet queue_frac over
+    #: ``autoscale_up_queue_frac``, or SLO burn over
+    #: ``autoscale_up_burn_rate`` when set) and
+    #: ``autoscale_down_consecutive`` idle ticks before a shrink; every
+    #: move opens an ``autoscale_cooldown_s`` window in which no further
+    #: move is allowed — the fleet never flaps on one noisy sample.
+    autoscale_min: int = 0
+    autoscale_max: int = 0
+    autoscale_interval_s: float = 2.0
+    autoscale_up_queue_frac: float = 0.5
+    autoscale_up_burn_rate: Optional[float] = None
+    autoscale_down_queue_frac: float = 0.05
+    autoscale_up_consecutive: int = 3
+    autoscale_down_consecutive: int = 5
+    autoscale_cooldown_s: float = 30.0
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -182,6 +211,24 @@ class FleetConfig:
             raise ValueError(
                 "canary_proxy_budget must be > 0 (None disables the "
                 "proxy gate)")
+        if self.autoscale_max:
+            if self.autoscale_min < 1:
+                raise ValueError(
+                    "autoscale_min must be >= 1 when autoscaling is on")
+            if not (self.autoscale_min <= self.replicas
+                    <= self.autoscale_max):
+                raise ValueError(
+                    f"replicas ({self.replicas}) must start inside "
+                    f"[autoscale_min, autoscale_max] = "
+                    f"[{self.autoscale_min}, {self.autoscale_max}]")
+            if self.autoscale_interval_s <= 0:
+                raise ValueError("autoscale_interval_s must be > 0")
+            if (self.autoscale_up_consecutive < 1
+                    or self.autoscale_down_consecutive < 1):
+                raise ValueError(
+                    "autoscale_*_consecutive must be >= 1")
+            if self.autoscale_cooldown_s < 0:
+                raise ValueError("autoscale_cooldown_s must be >= 0")
 
 
 class _LabeledSink:
@@ -258,6 +305,18 @@ class Replica:
             return 0
         return int(eng.health()["pending"])
 
+    def queue_capacity(self) -> Optional[int]:
+        """THIS replica's admission-queue bound, read through the
+        engine facade — heterogeneous fleets (a remote with a different
+        ``max_queue``) must not be spilled against a shared config's
+        capacity.  ``None`` when unknowable (no engine, or a remote
+        that has not learned its bound yet)."""
+        eng = self.engine
+        if eng is None:
+            return None
+        fn = getattr(eng, "queue_capacity", None)
+        return fn() if fn is not None else None
+
     def breaker_open(self) -> bool:
         with self._lock:
             return time.monotonic() < self._broken_until
@@ -319,6 +378,21 @@ class ReplicaFleet:
         self.weights_version = 1
         self.replicas: List[Replica] = [
             Replica(i) for i in range(fleet_cfg.replicas)]
+        if fleet_cfg.remote:
+            # Lazy import: remote.py imports this module's Replica, so
+            # the fleet must not import remote at module load.
+            from raft_tpu.serve.remote import (RemoteConfig,
+                                               RemoteReplica)
+
+            rcfg = fleet_cfg.remote_cfg or RemoteConfig()
+            for addr in fleet_cfg.remote:
+                self.replicas.append(
+                    RemoteReplica(len(self.replicas), addr, rcfg))
+        #: Next fresh replica index — scale-ups keep numbering past any
+        #: retired names (a drained r2 never comes back as a different
+        #: engine under the same name).
+        self._next_index = len(self.replicas)
+        self._router = None
         self.aot_dir = fleet_cfg.aot_dir or tempfile.mkdtemp(
             prefix="raft-aot-")
         self._started = False
@@ -338,6 +412,21 @@ class ReplicaFleet:
             "raft_fleet_quality_drift_total",
             "replica-local quality_drift firings surfaced by the "
             "supervisor, by replica and proxy")
+        self._scale_events = self.registry.counter(
+            "raft_fleet_scale_events_total",
+            "autoscaler replica-count changes, by direction")
+        # Autoscaler state (supervisor thread only): hysteresis streaks,
+        # the cooldown window, and the flap count (direction reversals
+        # — the check_regression.py --max-scale-flaps gate reads it off
+        # the fleet_scale events).
+        self._scale_last_dir: Optional[str] = None
+        self._scale_flaps = 0
+        self._scale_ups = 0
+        self._scale_downs = 0
+        self._scale_cooldown_until = 0.0
+        self._up_streak = 0
+        self._down_streak = 0
+        self._autoscale_next = 0.0
         # Quality-drift dedup per (replica, engine generation, proxy)
         # and the cached golden-batch reference scores of the CURRENT
         # serving weights (update_weights' proxy gate; invalidated by
@@ -376,6 +465,14 @@ class ReplicaFleet:
                 "fleet_config",
                 lambda: dataclasses.asdict(self.fleet_cfg))
 
+    def bind_router(self, router) -> None:
+        """The router registers itself at construction
+        (``FlowRouter.__init__``) so graceful scale-down can evacuate
+        the victim's streaming sessions (``router.evacuate``) before
+        the drain — sessions survive the shrink via ``stream_restart``
+        replay instead of dying with their lane."""
+        self._router = router
+
     def _collect(self, _reg) -> None:
         states: Dict[str, int] = {}
         for r in self.replicas:
@@ -406,6 +503,11 @@ class ReplicaFleet:
             raise RuntimeError("fleet already started")
         self._started = True
         for r in self.replicas:
+            if getattr(r, "is_remote", False):
+                # The remote host owns its engine lifecycle; this side
+                # only builds the wire client (no device work).
+                r.start(sink=self._sink)
+                continue
             eng = self._build_engine(replica=r.name)
             eng.start()
             if self.fleet_cfg.warmup_shapes:
@@ -466,9 +568,19 @@ class ReplicaFleet:
     def _supervise(self) -> None:
         poll = self.fleet_cfg.health_poll_s
         while not self._stop_event.wait(poll):
-            for r in self.replicas:
+            # Snapshot: _scale_up/_scale_down swap self.replicas
+            # copy-on-write on this same thread, but a snapshot keeps
+            # the iteration obviously safe either way.
+            for r in list(self.replicas):
                 if self._stop_event.is_set():
                     return
+                if getattr(r, "is_remote", False):
+                    # No rebuild across the wire: poll() watches the
+                    # health transition and rejoins the replica
+                    # (generation bump + breaker reset) when the
+                    # partition heals.
+                    r.poll(self._sink)
+                    continue
                 if r.state != "ready":
                     continue
                 eng = r.engine
@@ -486,6 +598,18 @@ class ReplicaFleet:
                         > self.fleet_cfg.backoff_reset_s):
                     r.backoff_level = 0
                 self._note_quality_drift(r, eng)
+            if (self.fleet_cfg.autoscale_max
+                    and time.monotonic() >= self._autoscale_next):
+                self._autoscale_next = (
+                    time.monotonic()
+                    + self.fleet_cfg.autoscale_interval_s)
+                try:
+                    self._autoscale_tick()
+                except Exception as e:
+                    # A failed scale move must not kill supervision.
+                    self._sink.emit(
+                        "fleet_scale_error",
+                        error=f"{type(e).__name__}: {str(e)[:300]}")
             if self._incidents is not None:
                 # Quiet-close poll: an incident over a stream that went
                 # silent still closes (and writes its final bundle)
@@ -574,6 +698,145 @@ class ReplicaFleet:
             return
 
     # ------------------------------------------------------------------
+    # elastic autoscaling (supervisor thread)
+    # ------------------------------------------------------------------
+
+    def _local_replicas(self) -> List[Replica]:
+        return [r for r in self.replicas
+                if not getattr(r, "is_remote", False)]
+
+    def load_signals(self) -> dict:
+        """Fleet-wide load snapshot over ELIGIBLE replicas: queue
+        pressure summed (pending and capacity pool across the fleet),
+        burn rate / occupancy / latency taken at the worst replica
+        (a saturated straggler is the scaling signal even when the
+        mean looks fine).  Cheap by contract — every per-engine
+        ``load_signals()`` reads locks/atomics only."""
+        pending = cap = 0
+        burn = occ = lat = 0.0
+        mfu = None
+        for r in list(self.replicas):
+            if not r.eligible():
+                continue
+            eng = r.engine
+            fn = getattr(eng, "load_signals", None)
+            if fn is None:
+                continue
+            try:
+                sig = fn()
+            except Exception:
+                continue
+            pending += int(sig.get("pending") or 0)
+            cap += int(sig.get("max_queue") or 0)
+            burn = max(burn, float(sig.get("burn_rate") or 0.0))
+            occ = max(occ, float(sig.get("occupancy") or 0.0))
+            lat = max(lat, float(sig.get("latency_p95_ms") or 0.0))
+            m = sig.get("mfu")
+            if m is not None:
+                mfu = m if mfu is None else max(mfu, m)
+        return {"pending": pending, "max_queue": cap,
+                "queue_frac": round(pending / cap, 4) if cap else 0.0,
+                "burn_rate": round(burn, 4),
+                "occupancy": round(occ, 4), "mfu": mfu,
+                "latency_p95_ms": round(lat, 2)}
+
+    def _autoscale_tick(self) -> None:
+        """One scaling decision.  Hysteresis + cooldown keep it from
+        flapping: pressure must persist ``autoscale_up_consecutive``
+        ticks (idle: ``autoscale_down_consecutive``) before a move, and
+        each move blocks further moves for ``autoscale_cooldown_s``."""
+        cfg = self.fleet_cfg
+        sig = self.load_signals()
+        up = sig["queue_frac"] >= cfg.autoscale_up_queue_frac
+        if cfg.autoscale_up_burn_rate is not None:
+            up = up or sig["burn_rate"] >= cfg.autoscale_up_burn_rate
+        down = (not up
+                and (sig["pending"] == 0
+                     or sig["queue_frac"]
+                     <= cfg.autoscale_down_queue_frac))
+        self._up_streak = self._up_streak + 1 if up else 0
+        self._down_streak = self._down_streak + 1 if down else 0
+        if time.monotonic() < self._scale_cooldown_until:
+            return
+        ready_locals = [r for r in self._local_replicas()
+                        if r.state == "ready"]
+        n = len(ready_locals)
+        if (up and self._up_streak >= cfg.autoscale_up_consecutive
+                and n < cfg.autoscale_max):
+            self._scale_up(sig)
+        elif (down
+              and self._down_streak >= cfg.autoscale_down_consecutive
+              and n > cfg.autoscale_min):
+            # Victim = the NEWEST ready local replica: the longest-lived
+            # ones hold the warmest affinity history.
+            self._scale_down(ready_locals[-1], sig)
+
+    def _scale_up(self, sig: dict) -> None:
+        """Grow by one local replica.  The AOT artifact replica 0
+        exported at bring-up makes this compile-free: the new engine
+        imports the executables and serves its first request without a
+        JIT compile."""
+        t0 = time.perf_counter()
+        r = Replica(self._next_index)
+        self._next_index += 1
+        eng = self._build_engine(replica=r.name)
+        eng.start()
+        if self.fleet_cfg.warmup_shapes:
+            eng.warmup(self.fleet_cfg.warmup_shapes)
+        r.adopt(eng)
+        r.set_state("ready")
+        # Copy-on-write: the router iterates fleet.replicas lock-free.
+        self.replicas = self.replicas + [r]
+        self._note_scale("up", r, sig, time.perf_counter() - t0)
+
+    def _scale_down(self, victim: Replica, sig: dict) -> None:
+        """Shrink by one local replica, gracefully: mark it draining
+        (placement stops immediately), move its streaming sessions to
+        siblings (``stream_restart reason=scale_down`` replay), then
+        drain in-flight work to completion — zero dropped requests by
+        construction."""
+        t0 = time.perf_counter()
+        victim.set_state("draining")
+        moved: list = []
+        if self._router is not None:
+            try:
+                moved = self._router.evacuate(victim.name,
+                                              reason="scale_down")
+            except Exception as e:
+                self._sink.emit(
+                    "fleet_scale_error", replica=victim.name,
+                    error=f"evacuate: {type(e).__name__}: "
+                          f"{str(e)[:300]}")
+        eng = victim.engine
+        if eng is not None:
+            eng.stop(drain=True,
+                     timeout=self.fleet_cfg.drain_timeout_s)
+        victim.set_state("stopped")
+        self.replicas = [r for r in self.replicas if r is not victim]
+        self._note_scale("down", victim, sig,
+                         time.perf_counter() - t0, moved=len(moved))
+
+    def _note_scale(self, direction: str, r: Replica, sig: dict,
+                    seconds: float, **extra) -> None:
+        if (self._scale_last_dir is not None
+                and self._scale_last_dir != direction):
+            self._scale_flaps += 1
+        self._scale_last_dir = direction
+        if direction == "up":
+            self._scale_ups += 1
+        else:
+            self._scale_downs += 1
+        self._scale_cooldown_until = (
+            time.monotonic() + self.fleet_cfg.autoscale_cooldown_s)
+        self._up_streak = self._down_streak = 0
+        self._scale_events.inc(direction=direction)
+        self._sink.emit("fleet_scale", direction=direction,
+                        replica=r.name, replicas=len(self.replicas),
+                        flaps=self._scale_flaps,
+                        seconds=round(seconds, 3), signals=sig,
+                        **extra)
+
+    # ------------------------------------------------------------------
     # rolling weight updates
     # ------------------------------------------------------------------
 
@@ -619,9 +882,11 @@ class ReplicaFleet:
                 self._variables = new_vars
             flipped = []
             try:
-                for r in self.replicas:
+                for r in list(self.replicas):
                     if self._stop_event.is_set():
                         break
+                    if getattr(r, "is_remote", False):
+                        continue  # the remote host rolls its own weights
                     if r.state != "ready":
                         continue  # supervisor rebuilds it on new vars
                     if warming is not None:
@@ -880,6 +1145,14 @@ class ReplicaFleet:
                 "incidents": (self._incidents.snapshot()
                               if self._incidents is not None
                               else {"enabled": False}),
+                "autoscale": {
+                    "enabled": bool(self.fleet_cfg.autoscale_max),
+                    "min": self.fleet_cfg.autoscale_min,
+                    "max": self.fleet_cfg.autoscale_max,
+                    "ups": self._scale_ups,
+                    "downs": self._scale_downs,
+                    "flaps": self._scale_flaps,
+                },
             },
             "replicas": reps,
         }

@@ -87,11 +87,21 @@ def is_failover_error(exc: BaseException) -> bool:
     faults carry ``replica_fatal``; an engine that stopped or crashed
     under an in-flight request raises the lifecycle RuntimeErrors; a
     transient device error that exhausted the engine's in-replica retry
-    ladder may still succeed on a sibling's device."""
+    ladder may still succeed on a sibling's device.
+
+    Network taxonomy (multi-host fabric, ``raft_tpu/serve/remote.py``):
+    connection errors (refused / reset / ``RemoteDisconnected``),
+    timeouts (``socket.timeout`` IS ``TimeoutError``), and HTTP-503
+    carriers (``http_status`` attribute) all indict the remote HOST —
+    a partitioned replica must look exactly like a crashed one."""
     if getattr(exc, "replica_fatal", False):
         return True
     if isinstance(exc, QueueFullError):
         return False
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if getattr(exc, "http_status", None) == 503:
+        return True
     if isinstance(exc, RuntimeError):
         msg = str(exc)
         if ("engine stopped" in msg or "engine crashed" in msg
@@ -270,6 +280,12 @@ class FlowRouter:
                     f"{scfg.slo_latency_target_ms}ms", windows=policy))
             self._slo = slo_mod.SLOTracker(
                 specs, registry=self.registry, sink=self._sink)
+        # Autoscaling fleets need the router back-reference (graceful
+        # scale-down evacuates streams through it); duck-typed so stub
+        # fleets in tests need not implement it.
+        bind = getattr(fleet, "bind_router", None)
+        if bind is not None:
+            bind(self)
 
     # ------------------------------------------------------------------
     # client API (any thread)
@@ -446,6 +462,29 @@ class FlowRouter:
             if now - rst.t_last > rst.ttl_s and not rst.lock.locked():
                 del self._streams[sid]
 
+    def evacuate(self, replica_name: str,
+                 reason: str = "scale_down") -> List[str]:
+        """Migrate every streaming session owned by ``replica_name``
+        onto a sibling via the cold-restart replay path (the fleet
+        autoscaler calls this before draining a scale-down victim).
+        Sessions with no eligible target are left in place — the next
+        frame's owner-loss check restarts them lazily instead of
+        failing the evacuation.  Returns the migrated session ids."""
+        with self._streams_lock:
+            owned = [rst for rst in self._streams.values()
+                     if rst.replica == replica_name]
+        moved = []
+        for rst in owned:
+            with rst.lock:
+                if rst.replica != replica_name:
+                    continue  # a concurrent frame already moved it
+                try:
+                    self._restart_stream(rst, reason, {replica_name})
+                except RuntimeError:
+                    continue
+                moved.append(rst.sid)
+        return moved
+
     # ------------------------------------------------------------------
     # placement
     # ------------------------------------------------------------------
@@ -454,6 +493,22 @@ class FlowRouter:
         return [r for r in self.fleet.replicas
                 if r.name not in exclude and r.eligible()]
 
+    def _queue_capacity(self, replica) -> int:
+        """PER-REPLICA queue depth bound for the spill math.  A remote
+        replica's ``max_queue`` is its own (read through the facade);
+        only replicas without the method — or whose capacity is still
+        unknown, e.g. an unreachable remote — fall back to the shared
+        ``ServeConfig``."""
+        qc = getattr(replica, "queue_capacity", None)
+        if qc is not None:
+            try:
+                cap = qc()
+            except Exception:
+                cap = None
+            if cap:
+                return int(cap)
+        return self.fleet.serve_cfg.max_queue
+
     def _pick(self, bucket: tuple, exclude: Set[str]):
         """Affinity first, least-loaded fallback, health-gated."""
         candidates = self._eligible(exclude)
@@ -461,10 +516,9 @@ class FlowRouter:
             return None
         n = len(self.fleet.replicas)
         affine_idx = zlib.crc32(repr(bucket).encode()) % n
-        scfg = self.fleet.serve_cfg
-        spill = self.cfg.affinity_spill * scfg.max_queue
         for r in candidates:
-            if r.index == affine_idx and r.pending() < spill:
+            if r.index == affine_idx and r.pending() < (
+                    self.cfg.affinity_spill * self._queue_capacity(r)):
                 return r
         return min(candidates, key=lambda r: (r.pending(), r.index))
 

@@ -208,6 +208,25 @@ def parse_args(argv=None):
                         "carries the named rate — SLO tracking "
                         "silently off must not look like a healthy "
                         "burn rate")
+    p.add_argument("--max-scale-flaps", type=int, default=None,
+                   metavar="N",
+                   help="fail when a newest record's "
+                        "config.scale_flaps (autoscaler direction "
+                        "reversals over the run, from "
+                        "scripts/telemetry_summary.py / "
+                        "scripts/fabric_smoke.py; docs/SERVING.md "
+                        "'Multi-host fabric') exceeds N; also fails "
+                        "when NO record carries the figure — the "
+                        "autoscaler silently off must not look like a "
+                        "flap-free run (unset = no check)")
+    p.add_argument("--max-net-retry-rate", type=float, default=None,
+                   metavar="PCT",
+                   help="fail when a newest record's "
+                        "config.net_retry_rate (request-path wire "
+                        "failures as %% of routed requests, from "
+                        "scripts/fabric_smoke.py) exceeds PCT; also "
+                        "fails when NO record carries the figure "
+                        "(unset = no check)")
     p.add_argument("--require-tuned", action="store_true",
                    help="fail when a newest record's config lacks "
                         "`tuned: true` — i.e. its knobs did NOT come "
@@ -295,7 +314,8 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
           min_mfu=None, max_flops_per_pair_growth=None,
           max_quality_drift=None, max_canary_proxy_delta=None,
           min_warm_iters_saved_frac=None, max_stream_epe_delta=None,
-          max_incidents=None, max_slo_burn=None):
+          max_incidents=None, max_slo_burn=None, max_scale_flaps=None,
+          max_net_retry_rate=None):
     """``(failures, report)`` over the newest record of each metric."""
     failures, report = [], []
     cp_gates = dict(max_critical_path_ms or {})
@@ -314,6 +334,8 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
     cpx_seen = False
     wis_seen = False
     sed_seen = False
+    sf_seen = False
+    nrr_seen = False
     for metric, recs in sorted(series.items()):
         newest = recs[-1]
         value = newest.get("value")
@@ -533,6 +555,33 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
                             f"{v:g} > {budget:g} — the {name} SLO "
                             "burned its error budget faster than the "
                             "gate allows")
+        # Multi-host fabric gates (docs/SERVING.md "Multi-host
+        # fabric"): an autoscaler that reverses direction within one
+        # run is flapping (its hysteresis/cooldown knobs regressed),
+        # and a fabric drill whose wire-failure rate blows past the
+        # budget is retrying its way through a problem the failover
+        # machinery should have absorbed.
+        if max_scale_flaps is not None:
+            sf = cfg.get("scale_flaps")
+            if isinstance(sf, (int, float)):
+                sf_seen = True
+                if sf > max_scale_flaps:
+                    failures.append(
+                        f"{metric}: scale_flaps={int(sf)} > "
+                        f"{max_scale_flaps} — the autoscaler reversed "
+                        "direction more than the budget allows "
+                        "(hysteresis/cooldown too tight for the load)")
+        if max_net_retry_rate is not None:
+            nrr = cfg.get("net_retry_rate")
+            if isinstance(nrr, (int, float)):
+                nrr_seen = True
+                if nrr > max_net_retry_rate:
+                    failures.append(
+                        f"{metric}: net_retry_rate={nrr:g}% > "
+                        f"{max_net_retry_rate:g}% — the fabric burned "
+                        "more wire retries per routed request than the "
+                        "budget allows (partition outlasting the "
+                        "breaker, or a flaky link)")
         sn = cfg.get("serve_span_names")
         if isinstance(sn, list) and sn:
             missing = sorted(set(SERVE_REQUIRED_SPANS) - set(sn))
@@ -624,6 +673,17 @@ def check(series, max_drop_pct=10.0, window=3, min_vs_baseline=None,
             f"config.slo_burn_rates[{name!r}] — SLO tracking for that "
             "objective did not run (slo_* targets unset?); the gate "
             "cannot pass vacuously")
+    if max_scale_flaps is not None and not sf_seen:
+        failures.append(
+            "scale-flap gate: no record carries config.scale_flaps — "
+            "the autoscaler did not run (autoscale_max 0, or the "
+            "summary predates the fabric fold); the gate cannot pass "
+            "vacuously")
+    if max_net_retry_rate is not None and not nrr_seen:
+        failures.append(
+            "net-retry gate: no record carries config.net_retry_rate "
+            "— no fabric drill ran (scripts/fabric_smoke.py); the "
+            "gate cannot pass vacuously")
     if max_canary_proxy_delta is not None and not cpx_seen:
         failures.append(
             "canary-proxy gate: no record carries "
@@ -925,6 +985,30 @@ def _selftest() -> int:
          run([30.0, 31.0, 30.5],
              last_cfg={"slo_burn_rates": {"availability": 99.0}}),
          False),
+        ("scale flaps within budget pass",
+         run([30.0, 31.0, 30.5], last_cfg={"scale_flaps": 1},
+             max_scale_flaps=1), False),
+        ("scale flaps over budget fail",
+         run([30.0, 31.0, 30.5], last_cfg={"scale_flaps": 3},
+             max_scale_flaps=1), True),
+        ("zero scale flaps satisfy a zero budget",
+         run([30.0, 31.0, 30.5], last_cfg={"scale_flaps": 0},
+             max_scale_flaps=0), False),
+        ("scale-flap gate without data fails",
+         run([30.0, 31.0, 30.5], max_scale_flaps=1), True),
+        ("scale flaps without the gate pass",
+         run([30.0, 31.0, 30.5], last_cfg={"scale_flaps": 9}), False),
+        ("net retry rate within budget passes",
+         run([30.0, 31.0, 30.5], last_cfg={"net_retry_rate": 4.0},
+             max_net_retry_rate=25.0), False),
+        ("net retry rate over budget fails",
+         run([30.0, 31.0, 30.5], last_cfg={"net_retry_rate": 60.0},
+             max_net_retry_rate=25.0), True),
+        ("net-retry gate without data fails",
+         run([30.0, 31.0, 30.5], max_net_retry_rate=25.0), True),
+        ("hot net retry rate without the gate passes",
+         run([30.0, 31.0, 30.5],
+             last_cfg={"net_retry_rate": 99.0}), False),
     ]
 
     def run_lint(payload):
@@ -1008,7 +1092,9 @@ def main(argv=None):
                                  ("N", "critical:0")),
                              max_slo_burn=parse_named_gates(
                                  args.max_slo_burn, "--max-slo-burn",
-                                 ("RATE", "availability:1")))
+                                 ("RATE", "availability:1")),
+                             max_scale_flaps=args.max_scale_flaps,
+                             max_net_retry_rate=args.max_net_retry_rate)
     if args.lint_report:
         failures.extend(lint_gate(args.lint_report))
     print(json.dumps({"ok": not failures, "failures": failures,

@@ -92,7 +92,14 @@ def last_run(records):
     (``incident_open``/``incident_close``/``slo_burn`` events plus the
     final SLO gauge values, docs/OBSERVABILITY.md "Incidents & SLOs")
     over the whole log like ``faults`` — an incident that opened before
-    the last restart still happened."""
+    the last restart still happened.
+
+    ``fabric`` collects the multi-host fabric stream (``fleet_scale``
+    autoscaler moves, ``net_retry`` wire failures,
+    ``fleet_remote_rejoin`` partition heals; docs/SERVING.md
+    "Multi-host fabric") over the whole log — the
+    ``check_regression.py --max-scale-flaps / --max-net-retry-rate``
+    gates read the totals."""
     run_cfg, steps, health, spans, costs = None, [], [], [], []
     faults = {"sample_quarantine": 0, "ckpt_fallback": 0,
               "serve_retry": 0, "chaos_inject": 0}
@@ -100,6 +107,7 @@ def last_run(records):
     retires = {"warm": [], "cold": []}
     incidents = {"opened": [], "closed": 0, "burns": [],
                  "burn_gauge": {}, "budget_gauge": {}}
+    fabric = {"scales": [], "net_retries": 0, "rejoins": 0}
     for rec in records:
         ev = rec.get("event")
         if ev == "run_config":
@@ -127,6 +135,12 @@ def last_run(records):
             incidents["closed"] += 1
         elif ev == "slo_burn":
             incidents["burns"].append(rec)
+        elif ev == "fleet_scale":
+            fabric["scales"].append(rec)
+        elif ev == "net_retry":
+            fabric["net_retries"] += 1
+        elif ev == "fleet_remote_rejoin":
+            fabric["rejoins"] += 1
         elif ev == "metrics_summary":
             # The run's final raft_cost_mfu gauge values ride along as
             # a synthetic record so summarize() folds them next to the
@@ -149,7 +163,7 @@ def last_run(records):
         elif ev in faults:
             faults[ev] += 1
     return (run_cfg, steps, health, faults, spans, costs, quality,
-            retires, incidents)
+            retires, incidents, fabric)
 
 
 def _wait_s(rec):
@@ -343,9 +357,42 @@ def incident_summary(incidents):
     return out
 
 
+def fabric_summary(fabric):
+    """Fold the multi-host fabric stream (``fleet_scale`` /
+    ``net_retry`` / ``fleet_remote_rejoin`` events, serve/remote.py +
+    the fleet autoscaler) into config-block fields: autoscaler move
+    counts by direction, the flap count (direction reversals —
+    ``check_regression.py --max-scale-flaps`` gates it), request-path
+    wire-failure totals, and partition rejoins.  Returns ``{}`` for
+    logs without fabric events — old logs summarize unchanged."""
+    if not fabric or not (fabric.get("scales")
+                          or fabric.get("net_retries")
+                          or fabric.get("rejoins")):
+        return {}
+    out = {}
+    scales = fabric.get("scales", [])
+    if scales:
+        ups = sum(1 for s in scales if s.get("direction") == "up")
+        flaps = 0
+        last = None
+        for s in scales:
+            d = s.get("direction")
+            if last is not None and d != last:
+                flaps += 1
+            last = d
+        out["fleet_scale"] = {"ups": ups, "downs": len(scales) - ups,
+                              "flaps": flaps}
+        out["scale_flaps"] = flaps
+    if fabric.get("net_retries"):
+        out["net_retry_total"] = fabric["net_retries"]
+    if fabric.get("rejoins"):
+        out["remote_rejoins_total"] = fabric["rejoins"]
+    return out
+
+
 def summarize(run_cfg, steps, health=None, faults=None, spans=None,
               costs=None, quality=None, retires=None, incidents=None,
-              skip=2):
+              fabric=None, skip=2):
     if run_cfg is None:
         raise SystemExit("no run_config event in log (telemetry written "
                          "by an older build?) — cannot recover batch "
@@ -404,6 +451,8 @@ def summarize(run_cfg, steps, health=None, faults=None, spans=None,
     # Incident + SLO-burn fold (docs/OBSERVABILITY.md "Incidents &
     # SLOs").
     health_cfg.update(incident_summary(incidents))
+    # Multi-host fabric fold (docs/SERVING.md "Multi-host fabric").
+    health_cfg.update(fabric_summary(fabric))
     last_health = (health or [None])[-1]
     if last_health is not None:
         health_cfg["nonfinite_steps_total"] = last_health.get(
@@ -439,10 +488,11 @@ def summarize(run_cfg, steps, health=None, faults=None, spans=None,
 def main(argv=None):
     args = parse_args(argv)
     (run_cfg, steps, health, faults, spans, costs, quality,
-     retires, incidents) = last_run(iter_records(args.path))
+     retires, incidents, fabric) = last_run(iter_records(args.path))
     print(json.dumps(summarize(run_cfg, steps, health, faults, spans,
                                costs, skip=args.skip, quality=quality,
-                               retires=retires, incidents=incidents)))
+                               retires=retires, incidents=incidents,
+                               fabric=fabric)))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,484 @@
+"""Multi-host fabric tests (tier-1): the RemoteEngine facade over the
+npz wire protocol (loopback bitwise parity), the network-error taxonomy
+and its retry-vs-failover classification, X-Raft-Trace continuity
+across the wire (one tree), the ``serve.remote`` chaos seam's
+determinism, the partition -> heal -> rejoin state machine
+(generation-guarded breaker reset), heterogeneous per-replica spill
+capacity in the router, the ``heal=`` fault-plan grammar, and the
+end-to-end fabric drill (``scripts/fabric_smoke.py --tiny``).
+
+Budget discipline: ONE server engine (module-scoped) behind ONE
+loopback HTTP server serves every wire test in the file."""
+
+import http.client
+import importlib.util
+import json
+import os.path as osp
+import socket
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from raft_tpu import chaos
+from raft_tpu.chaos import is_transient_error
+from raft_tpu.cli.serve import make_server
+from raft_tpu.config import RAFTConfig
+from raft_tpu.obs import MetricRegistry, trace
+from raft_tpu.serve import (InferenceEngine, QueueFullError,
+                            RemoteConfig, RemoteEngine,
+                            RemoteNetworkError, RemoteProtocolError,
+                            RemoteReplica, ServeConfig,
+                            classify_network_error)
+from raft_tpu.serve.remote import (RemoteDisconnectedError,
+                                   RemoteRefusedError,
+                                   RemoteResetError,
+                                   RemoteTimeoutError,
+                                   RemoteUnavailableError)
+from raft_tpu.serve.router import (FlowRouter, RouterConfig,
+                                   is_failover_error)
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+CFG = RAFTConfig.small_model()
+ITERS = 2
+SHAPE = (36, 52)                # -> bucket (40, 56)
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, osp.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _images(rng, h=SHAPE[0], w=SHAPE[1]):
+    return (rng.uniform(0, 255, (h, w, 3)).astype(np.float32),
+            rng.uniform(0, 255, (h, w, 3)).astype(np.float32))
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, event, **fields):
+        self.records.append(dict(event=event, **fields))
+
+    def of(self, name):
+        return [r for r in self.records if r["event"] == name]
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    chaos.uninstall()
+    trace.reset_default_tracer()
+    yield
+    chaos.uninstall()
+    trace.reset_default_tracer()
+
+
+@pytest.fixture(scope="module")
+def variables():
+    import jax
+
+    from raft_tpu.models.raft import RAFT
+
+    model_img = jax.numpy.zeros((1, 40, 56, 3))
+    rng = jax.random.PRNGKey(0)
+    return RAFT(CFG).init({"params": rng, "dropout": rng},
+                          model_img, model_img, iters=1)
+
+
+@pytest.fixture(scope="module")
+def served(variables):
+    """The file's ONE compile: a real engine behind a real loopback
+    HTTP server — every wire test talks to this."""
+    eng = InferenceEngine(variables, CFG, ServeConfig(
+        iters=ITERS, max_batch=2, batch_sizes=(2,), max_wait_ms=5,
+        max_queue=8))
+    eng.start()
+    eng.warmup([SHAPE])
+    server = make_server(eng, "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    addr = f"127.0.0.1:{server.server_address[1]}"
+    yield eng, addr
+    server.shutdown()
+    eng.stop(drain=False)
+
+
+def _remote(addr, **kw):
+    base = dict(connect_timeout_s=1.0, request_timeout_s=60.0,
+                health_timeout_s=1.0)
+    base.update(kw)
+    return RemoteEngine(addr, RemoteConfig(**base))
+
+
+def _wait_for(pred, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# facade parity over the wire
+# ---------------------------------------------------------------------------
+
+
+def test_loopback_bitwise_parity(served):
+    """The same request through the wire and through the in-process
+    engine produces the IDENTICAL flow field — npz float32 round-trips
+    exactly, so the remote facade is bitwise transparent."""
+    eng, addr = served
+    rng = np.random.default_rng(0)
+    im1, im2 = _images(rng)
+    remote = _remote(addr)
+    try:
+        got = remote.infer(im1, im2, timeout=120)
+        want = eng.infer(im1, im2, timeout=120)
+        assert got.dtype == np.float32 and got.shape == SHAPE + (2,)
+        assert np.array_equal(got, want)
+        h = remote.health()
+        assert h["ready"] and h["remote"] == addr
+        # capacity learned from the remote's own /v1/stats (max_queue
+        # unset client-side) — the router's heterogeneous spill input
+        assert remote.queue_capacity() == 8
+        st = remote.stats()
+        assert st["remote"] == addr and st["pending_client"] == 0
+        assert st["max_queue"] == 8  # the overlaid remote snapshot
+    finally:
+        remote.stop()
+
+
+def test_submit_contract_mirrors_engine(served):
+    """Lifecycle + validation behave exactly like InferenceEngine:
+    bad shapes raise ValueError synchronously, a stopped client raises
+    the lifecycle RuntimeError, and the client-side in-flight bound
+    raises QueueFullError."""
+    _, addr = served
+    rng = np.random.default_rng(1)
+    im1, im2 = _images(rng)
+    remote = _remote(addr, max_queue=2)
+    try:
+        with pytest.raises(ValueError, match="matching"):
+            remote.submit(im1, im2[:-4])
+        with remote._pending_lock:  # deterministic: pin the bound
+            remote._pending = 2
+        with pytest.raises(QueueFullError):
+            remote.submit(im1, im2)
+        with remote._pending_lock:
+            remote._pending = 0
+        # structured 404 from the wire maps back onto ValueError
+        with pytest.raises(ValueError, match="unknown session"):
+            remote.stream_close("never-opened")
+    finally:
+        remote.stop()
+    with pytest.raises(RuntimeError, match="engine stopped"):
+        remote.submit(im1, im2)
+
+
+# ---------------------------------------------------------------------------
+# taxonomy: retry-vs-failover classification
+# ---------------------------------------------------------------------------
+
+
+def test_network_taxonomy_failover_classification():
+    """Every wire-failure class indicts the remote HOST (failover);
+    only timeouts are additionally transient (same-path retry is worth
+    one shot); protocol garbage is neither."""
+    for exc in (ConnectionRefusedError("refused"),
+                ConnectionResetError("reset"),
+                socket.timeout("deadline"),
+                http.client.RemoteDisconnected("gone"),
+                RemoteRefusedError("x"), RemoteResetError("x"),
+                RemoteTimeoutError("x"), RemoteDisconnectedError("x"),
+                RemoteUnavailableError("503"),
+                RemoteNetworkError("x")):
+        assert is_failover_error(exc), exc
+    for exc in (QueueFullError("full"), ValueError("bad shape"),
+                RemoteProtocolError("garbage")):
+        assert not is_failover_error(exc), exc
+    # transient = same-path retry makes sense (timeouts only)
+    for exc in (socket.timeout("t"), TimeoutError("t"),
+                RemoteTimeoutError("t")):
+        assert is_transient_error(exc), exc
+    for exc in (ConnectionRefusedError("r"),
+                http.client.RemoteDisconnected("d"),
+                RemoteRefusedError("x"), RemoteResetError("x"),
+                RemoteDisconnectedError("x"),
+                RemoteUnavailableError("x")):
+        assert not is_transient_error(exc), exc
+
+
+def test_classify_network_error_mapping():
+    """Stdlib transport exceptions map onto the taxonomy; order
+    matters (RemoteDisconnected IS a ConnectionResetError and
+    socket.timeout IS TimeoutError on modern Pythons)."""
+    cases = [
+        (http.client.RemoteDisconnected("x"), RemoteDisconnectedError),
+        (ConnectionRefusedError("x"), RemoteRefusedError),
+        (ConnectionResetError("x"), RemoteResetError),
+        (BrokenPipeError("x"), RemoteResetError),
+        (ConnectionAbortedError("x"), RemoteResetError),
+        (socket.timeout("x"), RemoteTimeoutError),
+        (TimeoutError("x"), RemoteTimeoutError),
+        (OSError("x"), RemoteNetworkError),
+    ]
+    for raw, want in cases:
+        got = classify_network_error(raw, "h:1")
+        assert type(got) is want, (raw, got)
+        assert "h:1" in str(got)
+    # already-classified errors pass through untouched
+    err = RemoteTimeoutError("already")
+    assert classify_network_error(err, "h:1") is err
+
+
+# ---------------------------------------------------------------------------
+# trace continuity across the wire
+# ---------------------------------------------------------------------------
+
+
+def test_trace_header_continuity_one_tree(served):
+    """The submitting thread's span rides X-Raft-Trace, so the remote
+    host's serve_http span (and everything under it) lands in the SAME
+    trace tree: one trace_id, serve_http parented on the client-side
+    attempt span."""
+    _, addr = served
+    sink = _ListSink()
+    trace.configure(sample_rate=1.0, sink=sink)
+    rng = np.random.default_rng(2)
+    remote = _remote(addr)
+    try:
+        root = trace.default_tracer().start_trace("route")
+        att = root.child("attempt", replica="r1")
+        with trace.use_context(att):
+            fut = remote.submit(*_images(rng))
+        assert fut.result(timeout=120).shape == SHAPE + (2,)
+        att.end(status="ok")
+        root.end(status="ok")
+    finally:
+        remote.stop()
+    # the serve_http span flushes from the handler thread — allow it
+    # a moment to land in the sink
+    _wait_for(lambda: any(r.get("name") == "serve_http"
+                          for r in sink.records), 5,
+              "the server-side serve_http span to flush")
+    spans = [r for r in sink.records if r["event"] == trace.EVENT]
+    assert {s["trace_id"] for s in spans} == {root.trace_id}, \
+        "the wire hop split the trace into multiple trees"
+    http_spans = [s for s in spans if s["name"] == "serve_http"]
+    assert len(http_spans) == 1
+    assert http_spans[0]["parent_id"] == att.span_id
+
+
+# ---------------------------------------------------------------------------
+# the serve.remote chaos seam
+# ---------------------------------------------------------------------------
+
+
+def test_net_chaos_deterministic_and_replayable(served):
+    """``net_refuse@step=1``: exactly the SECOND wire operation fails,
+    classified and counted — and an identical plan replays the
+    identical outcome (same seed, same ordinals)."""
+    _, addr = served
+    rng = np.random.default_rng(3)
+    im1, im2 = _images(rng)
+    for _ in range(2):  # second pass replays the first exactly
+        sink = _ListSink()
+        remote = RemoteEngine(addr, RemoteConfig(), sink=sink)
+        chaos.install(chaos.FaultPlan.parse("net_refuse@step=1",
+                                            seed=7))
+        try:
+            assert remote.infer(im1, im2, timeout=120).shape \
+                == SHAPE + (2,)
+            with pytest.raises(RemoteRefusedError):
+                remote.infer(im1, im2, timeout=120)
+            assert remote.infer(im1, im2, timeout=120).shape \
+                == SHAPE + (2,)
+        finally:
+            chaos.uninstall()
+            remote.stop()
+        retries = sink.of("net_retry")
+        assert len(retries) == 1
+        assert retries[0]["kind"] == "refused"
+        assert retries[0]["path"] == "/v1/flow"
+        counts = {dict(k).get("kind"): v
+                  for k, v in remote._net_errors.items()}
+        assert counts == {"refused": 1}
+
+
+def test_net_drop_is_mid_response_disconnect(served):
+    """``net_drop`` lets the request REACH the server (it executes)
+    but the response never arrives — the client sees a mid-response
+    disconnect, a failover-class error."""
+    eng, addr = served
+    rng = np.random.default_rng(4)
+    im1, im2 = _images(rng)
+    before = eng.stats()["completed"]
+    remote = _remote(addr)
+    chaos.install(chaos.FaultPlan.parse("net_drop@step=0", seed=0))
+    try:
+        with pytest.raises(RemoteDisconnectedError):
+            remote.infer(im1, im2, timeout=120)
+    finally:
+        chaos.uninstall()
+        remote.stop()
+    _wait_for(lambda: eng.stats()["completed"] == before + 1, 60,
+              "the dropped request to finish server-side "
+              "(net_drop must fire AFTER the request went out)")
+
+
+def test_partition_heal_rejoin_generation_guard(served):
+    """The RemoteReplica supervisor hook: during a partition the
+    replica reads down; on heal it REJOINS — generation bump +
+    breaker reset under the lock, so strikes earned against the
+    partitioned generation cannot sideline the healed host."""
+    _, addr = served
+    sink = _ListSink()
+    r = RemoteReplica(1, addr, RemoteConfig(
+        connect_timeout_s=1.0, health_timeout_s=1.0,
+        health_cache_s=0.0))  # every health() is a real wire probe
+    r.start(sink=sink)
+    try:
+        assert r.eligible()
+        gen0 = r.generation
+        # the router striking the partitioned replica opens its breaker
+        assert r.note_failure(threshold=1, cooldown_s=60.0)
+        assert r.breaker_open() and not r.eligible()
+        chaos.install(chaos.FaultPlan.parse(
+            "net_partition@step=0,heal=3", seed=0))
+        for _ in range(3):          # ordinals 0..2: partitioned
+            r.poll(sink)
+            assert r.generation == gen0
+        r.poll(sink)                # ordinal 3: healed -> rejoin
+        assert r.generation == gen0 + 1
+        assert not r.breaker_open()
+        chaos.uninstall()
+        assert r.eligible()
+        rejoins = sink.of("fleet_remote_rejoin")
+        assert len(rejoins) == 1
+        assert rejoins[0]["replica"] == "r1"
+        assert rejoins[0]["generation"] == gen0 + 1
+        # a second healthy poll must NOT rejoin again
+        r.poll(sink)
+        assert len(sink.of("fleet_remote_rejoin")) == 1
+    finally:
+        chaos.uninstall()
+        eng = r.engine
+        if eng is not None:
+            eng.stop()
+
+
+def test_heal_grammar():
+    """``step=S,heal=H`` fires on ordinals [S, H) — unlimited times
+    inside the window, never outside; heal= without step= (or
+    heal <= step) is a spec error."""
+    plan = chaos.FaultPlan.parse("net_partition@step=2,heal=5", seed=0)
+    fires = [plan.fires("net_partition") for _ in range(8)]
+    assert fires == [False, False, True, True, True,
+                     False, False, False]
+    assert plan.counts() == {"net_partition": 3}
+    with pytest.raises(chaos.ChaosSpecError, match="heal= needs"):
+        chaos.FaultPlan.parse("net_partition@p=0.5,heal=5")
+    with pytest.raises(chaos.ChaosSpecError, match="must be >"):
+        chaos.FaultPlan.parse("net_partition@step=5,heal=5")
+
+
+# ---------------------------------------------------------------------------
+# router spill math with heterogeneous capacity
+# ---------------------------------------------------------------------------
+
+
+class _StubReplica:
+    def __init__(self, index, pending, cap):
+        self.index = index
+        self.name = f"r{index}"
+        self.state = "ready"
+        self.generation = 1
+        self._pending = pending
+        self._cap = cap
+
+    def eligible(self):
+        return True
+
+    def pending(self):
+        return self._pending
+
+    def queue_capacity(self):
+        if isinstance(self._cap, Exception):
+            raise self._cap
+        return self._cap
+
+    def breaker_open(self):
+        return False
+
+    def note_failure(self, threshold, cooldown_s):
+        return False
+
+    def note_success(self):
+        pass
+
+
+class _StubFleet:
+    def __init__(self, replicas, max_queue=64):
+        self.replicas = replicas
+        self.serve_cfg = ServeConfig(max_queue=max_queue)
+        self.registry = MetricRegistry()
+
+
+def test_spill_uses_per_replica_capacity():
+    """The affinity-spill threshold must read THE replica's own
+    capacity through the facade: a remote with max_queue=4 spills at
+    pending 3 even though the shared ServeConfig says 64; a replica
+    with unknown capacity falls back to the shared config."""
+    bucket = (40, 56)
+    affine = zlib.crc32(repr(bucket).encode()) % 2
+    small = _StubReplica(affine, pending=3, cap=4)
+    other = _StubReplica(1 - affine, pending=2, cap=64)
+    router = FlowRouter(_StubFleet(sorted([small, other],
+                                          key=lambda r: r.index)),
+                        RouterConfig())
+    # 3 >= 0.75 * 4: the heterogeneous replica is saturated -> spill
+    assert router._pick(bucket, set()) is other
+    # same pending against the SHARED capacity would have kept it
+    small._cap = None
+    assert router._pick(bucket, set()) is small
+    # a capacity probe that fails (unreachable remote) also falls back
+    small._cap = RemoteTimeoutError("probe timed out")
+    assert router._pick(bucket, set()) is small
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end drill
+# ---------------------------------------------------------------------------
+
+
+def test_fabric_smoke_tiny(capsys):
+    """The fabric drill the PR promises: partition -> failover with
+    zero drops and ONE correlated incident; heal -> rejoin; queue
+    pressure -> exactly one scale-up; idle -> graceful scale-down with
+    the stream surviving via ``stream_restart reason=scale_down``."""
+    mod = _load_script("fabric_smoke")
+    rc = mod.main(["--tiny"])
+    out = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(out[-1])
+    assert rc == 0
+    assert rec["metric"] == "fabric_smoke" and rec["value"] == 1.0
+    cfg = rec["config"]
+    assert cfg["dropped"] == 0 and cfg["failovers"] >= 1
+    assert cfg["fleet_scale"] == {"ups": 1, "downs": 1, "flaps": 1}
+    assert cfg["scale_flaps"] <= 1
+    assert cfg["net_retry_total"] >= 1
+    assert cfg["incidents_opened"] == 1
+    assert cfg["scale_down"]["streams_moved"] == 1

@@ -172,7 +172,8 @@ def test_run_config_carries_tuning_stamp(tmp_path):
     telem.sink.close()
     ts = _load_script("telemetry_summary")
     (run_cfg, steps, health, faults, spans, costs, quality,
-     retires, incidents) = ts.last_run(ts.iter_records(str(tmp_path)))
+     retires, incidents, fabric) = ts.last_run(
+        ts.iter_records(str(tmp_path)))
     assert run_cfg["tuned"] is True
     out = ts.summarize(run_cfg, steps, health, faults, spans, costs,
                        quality, retires, skip=0)
